@@ -1,0 +1,1 @@
+"""CRDT core: clocks, changes, host apply path, patch generation."""

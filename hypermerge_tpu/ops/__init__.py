@@ -1,0 +1,1 @@
+"""Batched JAX/XLA kernels: the TPU compute path of the framework."""

@@ -1,0 +1,1 @@
+"""Host-side utility primitives (queues, id codecs, logging)."""

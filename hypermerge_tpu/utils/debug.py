@@ -1,0 +1,68 @@
+"""Namespaced debug logging + micro-bench timers.
+
+Mirrors the reference's observability story (SURVEY.md §5): the `debug`
+library with per-component namespaces gated by the DEBUG env var (reference
+src/Debug.ts:1-8, src/RepoBackend.ts:42), plus per-apply wall-clock timers
+(reference src/DocBackend.ts:207-212). Timers additionally aggregate into a
+process-wide registry that bench.py reads.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import sys
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, Tuple
+
+_PATTERNS = [p for p in os.environ.get("DEBUG", "").split(",") if p]
+
+
+def enabled(namespace: str) -> bool:
+    return any(fnmatch.fnmatch(namespace, pat) for pat in _PATTERNS)
+
+
+def log(namespace: str, *args: Any) -> None:
+    if enabled(namespace):
+        print(f"[{namespace}]", *args, file=sys.stderr)
+
+
+def trace(label: str) -> Callable[..., Any]:
+    """Logging combinator: returns a fn that logs its args and returns the
+    first one (reference src/Debug.ts trace)."""
+
+    def _trace(first: Any = None, *rest: Any) -> Any:
+        log("trace", label, first, *rest)
+        return first
+
+    return _trace
+
+
+# -- timers ----------------------------------------------------------------
+
+_TIMINGS: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
+
+
+@contextmanager
+def bench(label: str) -> Iterator[None]:
+    """Wall-clock one section; aggregates (count, total_seconds) per label
+    (reference src/DocBackend.ts:207-212 logs per-apply ms; we also keep a
+    cumulative registry like src/Metadata.ts:244-251)."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        count, total = _TIMINGS[label]
+        _TIMINGS[label] = (count + 1, total + dt)
+        log("bench", f"{label}: {dt * 1e3:.3f}ms")
+
+
+def timings() -> Dict[str, Tuple[int, float]]:
+    return dict(_TIMINGS)
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()

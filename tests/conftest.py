@@ -1,0 +1,31 @@
+"""Test env: force an 8-device virtual CPU mesh before jax backends init.
+
+Multi-chip hardware is unavailable in CI; sharding tests run over
+xla_force_host_platform_device_count=8 exactly as the driver's
+dryrun_multichip does (see __graft_entry__.py).
+
+Note: this environment pre-registers an `axon` TPU platform via
+sitecustomize and overrides JAX_PLATFORMS, so plain env vars are not
+enough — we must update jax.config before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import random
+
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return random.Random(0xC0FFEE)

@@ -1,0 +1,146 @@
+"""Clock algebra truth tables — host pure fns and device kernels must agree.
+
+Mirrors the reference's tests/unit.test.ts (cmp/union truth table) and adds a
+randomized host==device equivalence sweep the reference lacks.
+"""
+
+import math
+import random
+
+import jax.numpy as jnp
+import pytest
+
+from hypermerge_tpu.crdt import clock as C
+from hypermerge_tpu.ops import clock_kernels as K
+
+
+def test_cmp_truth_table():
+    cases = [
+        ({}, {}, C.Ordering.EQ),
+        ({"a": 1}, {"a": 1}, C.Ordering.EQ),
+        ({"a": 2}, {"a": 1}, C.Ordering.GT),
+        ({"a": 1}, {"a": 2}, C.Ordering.LT),
+        ({"a": 1}, {}, C.Ordering.GT),
+        ({}, {"a": 1}, C.Ordering.LT),
+        ({"a": 1}, {"b": 1}, C.Ordering.CONCUR),
+        ({"a": 2, "b": 1}, {"a": 1, "b": 2}, C.Ordering.CONCUR),
+        ({"a": 2, "b": 2}, {"a": 1, "b": 2}, C.Ordering.GT),
+        ({"a": 1, "b": 1}, {"a": 1, "b": 1, "c": 1}, C.Ordering.LT),
+    ]
+    for a, b, expected in cases:
+        assert C.cmp(a, b) is expected, (a, b)
+
+
+def test_union_intersection():
+    a = {"a": 3, "b": 1}
+    b = {"a": 1, "b": 5, "c": 2}
+    assert C.union(a, b) == {"a": 3, "b": 5, "c": 2}
+    assert C.intersection(a, b) == {"a": 1, "b": 1}
+    assert C.intersection({"a": 1}, {"b": 1}) == {}
+
+
+def test_gte_equivalent():
+    assert C.gte({"a": 2, "b": 2}, {"a": 2})
+    assert not C.gte({"a": 2}, {"a": 2, "b": 1})
+    assert C.equivalent({"a": 1}, {"a": 1})
+    assert not C.equivalent({"a": 1}, {"a": 2})
+
+
+def test_strs_codec_roundtrip():
+    clock = {"actorA": 5, "actorB": C.INFINITY_SEQ}
+    strs = C.clock_to_strs(clock)
+    assert strs == ["actorA:5", "actorB"]
+    assert C.strs_to_clock(strs) == clock
+    assert C.clock_to_strs({"x": math.inf}) == ["x"]
+
+
+def test_add_to_in_place():
+    acc = {"a": 1}
+    C.add_to(acc, {"a": 3, "b": 2})
+    C.add_to(acc, {"a": 2})
+    assert acc == {"a": 3, "b": 2}
+
+
+def test_pack_unpack_roundtrip():
+    clocks = [{"a": 1, "c": 7}, {"b": 2}, {}]
+    actors = C.actor_axis(clocks)
+    rows = C.pack(clocks, actors)
+    assert C.unpack(rows, actors) == clocks
+
+
+_CODE_TO_ORD = {K.EQ: C.Ordering.EQ, K.GT: C.Ordering.GT,
+                K.LT: C.Ordering.LT, K.CONCUR: C.Ordering.CONCUR}
+
+
+def test_device_matches_host_randomized():
+    rnd = random.Random(7)
+    actors = [f"actor{i}" for i in range(6)]
+    clocks = []
+    for _ in range(64):
+        clocks.append(
+            {a: rnd.randint(1, 9) for a in actors if rnd.random() < 0.6}
+        )
+    rows = K.pack_clocks(C.pack(clocks, actors))
+    n = len(clocks)
+    # all-pairs cmp on device in one dispatch; single bulk transfer back
+    import numpy as np
+
+    a = jnp.repeat(rows, n, axis=0)
+    b = jnp.tile(rows, (n, 1))
+    codes = np.asarray(K.cmp(a, b))
+    unions = np.asarray(K.union(a, b))
+    inters = np.asarray(K.intersection(a, b))
+    gtes = np.asarray(K.gte(a, b))
+    for i in range(n):
+        for j in range(n):
+            k = i * n + j
+            assert _CODE_TO_ORD[int(codes[k])] is C.cmp(clocks[i], clocks[j])
+            assert bool(gtes[k]) == C.gte(clocks[i], clocks[j])
+            host_u = C.pack([C.union(clocks[i], clocks[j])], actors)[0]
+            assert list(map(int, unions[k])) == host_u
+            host_i = C.pack([C.intersection(clocks[i], clocks[j])], actors)[0]
+            assert list(map(int, inters[k])) == host_i
+
+
+def test_union_reduce_matches_fold():
+    rnd = random.Random(3)
+    actors = [f"a{i}" for i in range(4)]
+    clocks = [{a: rnd.randint(0, 5) for a in actors} for _ in range(50)]
+    rows = K.pack_clocks(C.pack(clocks, actors))
+    device = list(map(int, K.union_reduce(rows)))
+    host = {}
+    for c in clocks:
+        C.add_to(host, c)
+    assert device == C.pack([host], actors)[0]
+
+
+def test_satisfied_and_cursor_window():
+    doc = K.pack_clocks([[3, 1, 0]])
+    minimum = K.pack_clocks([[2, 1, 0]])
+    assert bool(K.satisfied(doc, minimum)[0])
+    minimum2 = K.pack_clocks([[2, 2, 0]])
+    assert not bool(K.satisfied(doc, minimum2)[0])
+
+    cursor = K.pack_clocks([[5, 1, int(K.INT32_INF)]])
+    window = K.cursor_window(doc, cursor)
+    assert list(map(int, window[0])) == [2, 0, int(K.INT32_INF)]
+
+
+def test_infinity_clamps_to_int32():
+    rows = K.pack_clocks(C.pack([{"a": C.INFINITY_SEQ}], ["a"]))
+    assert int(rows[0, 0]) == int(K.INT32_INF)
+
+
+def test_pack_handles_math_inf():
+    rows = C.pack([{"a": math.inf, "b": 2}], ["a", "b"])
+    assert rows == [[C.INFINITY_SEQ, 2]]
+
+
+def test_top_k_dominated_with_inf_entries():
+    clocks = K.pack_clocks(
+        [[int(K.INT32_INF), int(K.INT32_INF)], [1, 1], [9, 9]]
+    )
+    query = K.pack_clocks([[int(K.INT32_INF), int(K.INT32_INF)]])[0]
+    scores, idx = K.top_k_dominated(clocks, query, 3)
+    # all three dominated; the inf-clock doc must rank first, not wrap negative
+    assert int(idx[0]) == 0 and int(scores[0]) > 0

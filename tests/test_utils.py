@@ -1,0 +1,195 @@
+"""Utility layer: queue discipline, mapset, base58, ids, ed25519, json."""
+
+import threading
+
+import pytest
+
+from hypermerge_tpu.utils import base58, ed25519, ids, keys
+from hypermerge_tpu.utils.json_buffer import bufferify, parse, parse_all_valid
+from hypermerge_tpu.utils.mapset import MapSet
+from hypermerge_tpu.utils.queue import Queue
+
+
+class TestQueue:
+    def test_buffers_until_subscribe_then_direct(self):
+        q = Queue("t")
+        q.push(1)
+        q.push(2)
+        seen = []
+        q.subscribe(seen.append)
+        assert seen == [1, 2]
+        q.push(3)
+        assert seen == [1, 2, 3]
+
+    def test_second_subscriber_raises(self):
+        q = Queue("t")
+        q.subscribe(lambda x: None)
+        with pytest.raises(RuntimeError):
+            q.subscribe(lambda x: None)
+
+    def test_once(self):
+        q = Queue("t")
+        seen = []
+        q.once(seen.append)
+        q.push("a")
+        q.push("b")
+        assert seen == ["a"]
+        # "b" stays buffered for the next subscriber
+        out = []
+        q.subscribe(out.append)
+        assert out == ["b"]
+
+    def test_first_blocks_until_push(self):
+        q = Queue("t")
+        result = []
+
+        def waiter():
+            result.append(q.first(timeout=5))
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        q.push(42)
+        th.join(5)
+        assert result == [42]
+
+    def test_reentrant_push_preserves_order(self):
+        q = Queue("t")
+        seen = []
+
+        def sub(x):
+            seen.append(x)
+            if x == 1:
+                q.push(3)
+
+        q.subscribe(sub)
+        q.push(1)
+        q.push(2)
+        assert seen == [1, 3, 2]
+
+    def test_drain(self):
+        q = Queue("t")
+        q.push(1)
+        q.push(2)
+        assert q.drain() == [1, 2]
+        assert q.length == 0
+
+
+class TestMapSet:
+    def test_add_get_keyswith(self):
+        ms = MapSet()
+        assert ms.add("x", 1)
+        assert not ms.add("x", 1)
+        ms.add("x", 2)
+        ms.add("y", 2)
+        assert ms.get("x") == {1, 2}
+        assert sorted(ms.keys_with(2)) == ["x", "y"]
+        assert ms.keys_with(99) == []
+
+    def test_remove_cleans_empty(self):
+        ms = MapSet()
+        ms.add("x", 1)
+        ms.remove("x", 1)
+        assert "x" not in ms.keys()
+
+
+class TestBase58:
+    def test_roundtrip(self):
+        for data in [b"", b"\x00", b"\x00\x00hello", b"\xff" * 32, bytes(range(32))]:
+            assert base58.decode(base58.encode(data)) == data
+
+    def test_known_vector(self):
+        # 'hello world' standard base58 vector
+        assert base58.encode(b"hello world") == "StV1DL6CwTryKyV"
+        assert base58.decode("StV1DL6CwTryKyV") == b"hello world"
+
+    def test_invalid_char(self):
+        with pytest.raises(ValueError):
+            base58.decode("0OIl")
+
+
+class TestEd25519:
+    def test_rfc8032_vector_1(self):
+        # RFC 8032 §7.1 TEST 1 (empty message)
+        seed = bytes.fromhex(
+            "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+        )
+        pub = bytes.fromhex(
+            "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+        )
+        sig = bytes.fromhex(
+            "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+            "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+        )
+        assert ed25519.public_key(seed) == pub
+        assert ed25519.sign(b"", seed) == sig
+        assert ed25519.verify(b"", sig, pub)
+
+    def test_rfc8032_vector_2(self):
+        seed = bytes.fromhex(
+            "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+        )
+        pub = bytes.fromhex(
+            "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+        )
+        msg = bytes.fromhex("72")
+        sig = ed25519.sign(msg, seed)
+        assert sig == bytes.fromhex(
+            "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+            "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+        )
+        assert ed25519.verify(msg, sig, pub)
+        assert not ed25519.verify(b"tampered", sig, pub)
+
+    def test_keys_roundtrip_and_discovery(self):
+        pair = keys.create()
+        buf = keys.decode_pair(pair)
+        assert keys.encode_pair(buf) == pair
+        assert len(buf.public_key) == 32
+        d1 = keys.discovery_id(pair.public_key)
+        d2 = keys.discovery_id(pair.public_key)
+        assert d1 == d2
+        other = keys.create()
+        assert keys.discovery_id(other.public_key) != d1
+        # signing with the pair's seed verifies under its public key
+        sig = ed25519.sign(b"block", buf.secret_key)
+        assert ed25519.verify(b"block", sig, buf.public_key)
+
+
+class TestIds:
+    def test_url_roundtrip(self):
+        pair = keys.create()
+        url = ids.to_doc_url(pair.public_key)
+        assert ids.validate_doc_url(url) == pair.public_key
+        assert ids.url_to_id(url) == pair.public_key
+        furl = ids.to_hyperfile_url(pair.public_key)
+        assert ids.validate_file_url(furl) == pair.public_key
+        assert ids.is_doc_url(url) and not ids.is_doc_url(furl)
+
+    def test_invalid_urls(self):
+        with pytest.raises(ValueError):
+            ids.validate_doc_url("hypermerge:/notakey")
+        with pytest.raises(ValueError):
+            ids.validate_doc_url("http://example.com")
+        with pytest.raises(ValueError):
+            ids.validate_url("nonsense")
+
+    def test_root_actor_identity(self):
+        pair = keys.create()
+        assert ids.root_actor_id(ids.DocId(pair.public_key)) == pair.public_key
+
+
+class TestJsonBuffer:
+    def test_roundtrip(self):
+        obj = {"b": 1, "a": [1, 2, {"x": None}]}
+        assert parse(bufferify(obj)) == obj
+
+    def test_parse_all_valid_skips_corrupt(self):
+        bufs = [bufferify({"ok": 1}), b"\xff\xfe garbage", bufferify(2)]
+        assert parse_all_valid(bufs) == [{"ok": 1}, 2]
+
+
+def test_queue_first_with_none_item():
+    q = Queue("t")
+    q.push(None)
+    q.push(7)
+    assert q.first(timeout=1) is None
