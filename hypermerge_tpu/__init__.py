@@ -44,10 +44,6 @@ __all__ = [
 ]
 
 
-def _late_imports():  # pragma: no cover - import-order helper
-    """Heavier modules (jax, repo runtime) are imported lazily by callers."""
-
-
 try:  # re-export the runtime facade once it exists (built in later milestones)
     from .repo import Repo  # noqa: F401
 

@@ -25,9 +25,14 @@ class Ordering(enum.Enum):
     EQ = "EQ"
 
 
+def _norm(seq: float) -> float:
+    """math.inf and INFINITY_SEQ both mean 'infinite' — compare them equal."""
+    return INFINITY_SEQ if seq >= INFINITY_SEQ else seq
+
+
 def gte(a: Clock, b: Clock) -> bool:
     """True iff a dominates b: every actor's seq in b is <= its seq in a."""
-    return all(a.get(actor, 0) >= seq for actor, seq in b.items())
+    return all(_norm(a.get(actor, 0)) >= _norm(seq) for actor, seq in b.items())
 
 
 def cmp(a: Clock, b: Clock) -> Ordering:

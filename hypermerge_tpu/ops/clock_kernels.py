@@ -91,7 +91,7 @@ def top_k_dominated(clocks: jax.Array, query: jax.Array, k: int):
     return jax.lax.top_k(score, k)
 
 
-def pack_clocks(rows, dtype=jnp.int32) -> jax.Array:
+def pack_clocks(rows) -> jax.Array:
     """Host rows (crdt.clock.pack output) -> device array with int32 clamp."""
     import numpy as np
 

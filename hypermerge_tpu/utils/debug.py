@@ -11,13 +11,17 @@ from __future__ import annotations
 
 import fnmatch
 import os
+import re
 import sys
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, Tuple
 
-_PATTERNS = [p for p in os.environ.get("DEBUG", "").split(",") if p]
+_PATTERNS = [
+    p for p in re.split(r"[\s,]+", os.environ.get("DEBUG", "")) if p
+]
 
 
 def enabled(namespace: str) -> bool:
@@ -43,6 +47,7 @@ def trace(label: str) -> Callable[..., Any]:
 # -- timers ----------------------------------------------------------------
 
 _TIMINGS: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
+_TIMINGS_LOCK = threading.Lock()
 
 
 @contextmanager
@@ -55,8 +60,9 @@ def bench(label: str) -> Iterator[None]:
         yield
     finally:
         dt = time.perf_counter() - t0
-        count, total = _TIMINGS[label]
-        _TIMINGS[label] = (count + 1, total + dt)
+        with _TIMINGS_LOCK:
+            count, total = _TIMINGS[label]
+            _TIMINGS[label] = (count + 1, total + dt)
         log("bench", f"{label}: {dt * 1e3:.3f}ms")
 
 

@@ -78,7 +78,11 @@ def _decompress(s: bytes) -> Tuple[int, int, int, int]:
     n = int.from_bytes(s, "little")
     y = n & ((1 << 255) - 1)
     sign = n >> 255
+    if y >= _P:  # RFC 8032 §5.1.3: non-canonical y must fail
+        raise ValueError("non-canonical point encoding")
     x = _xrecover(y)
+    if x == 0 and sign == 1:  # -0 is not a valid encoding
+        raise ValueError("non-canonical point encoding")
     if x & 1 != sign:
         x = _P - x
     if (-x * x + y * y - 1 - _D * x * x * y * y) % _P != 0:

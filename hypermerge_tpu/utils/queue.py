@@ -40,14 +40,9 @@ class Queue(Generic[T]):
 
     def push(self, item: T) -> None:
         with self._lock:
-            if self._subscription is None:
-                self._buffer.append(item)
-                self._signal_first(item)
-                return
-            # Serialize with any in-flight drain: enqueue then drain in-order.
             self._buffer.append(item)
             self._signal_first(item)
-            self._drain_locked()
+        self._drain()
 
     def subscribe(self, subscriber: Callable[[T], None]) -> None:
         with self._lock:
@@ -57,7 +52,7 @@ class Queue(Generic[T]):
                 )
             log("queue:%s" % self.name, "subscribe")
             self._subscription = subscriber
-            self._drain_locked()
+        self._drain()
 
     def unsubscribe(self) -> None:
         with self._lock:
@@ -101,13 +96,25 @@ class Queue(Generic[T]):
                 ev.set()
             self._first_waiters.clear()
 
-    def _drain_locked(self) -> None:
-        if self._draining:
-            return
-        self._draining = True
-        try:
-            while self._buffer and self._subscription is not None:
+    def _drain(self) -> None:
+        # Subscriber callbacks run OUTSIDE the lock (a subscriber may push
+        # to other queues, or this one reentrantly). The _draining flag makes
+        # exactly one thread the drainer at a time, preserving order and the
+        # never-concurrent callback guarantee without holding the lock
+        # across user code.
+        while True:
+            with self._lock:
+                if (
+                    self._draining
+                    or not self._buffer
+                    or self._subscription is None
+                ):
+                    return
+                self._draining = True
                 item = self._buffer.popleft()
-                self._subscription(item)
-        finally:
-            self._draining = False
+                subscriber = self._subscription
+            try:
+                subscriber(item)
+            finally:
+                with self._lock:
+                    self._draining = False

@@ -144,3 +144,11 @@ def test_top_k_dominated_with_inf_entries():
     scores, idx = K.top_k_dominated(clocks, query, 3)
     # all three dominated; the inf-clock doc must rank first, not wrap negative
     assert int(idx[0]) == 0 and int(scores[0]) > 0
+
+
+def test_inf_and_infinity_seq_compare_equal():
+    a = {"x": math.inf}
+    b = C.strs_to_clock(C.clock_to_strs(a))
+    assert b == {"x": C.INFINITY_SEQ}
+    assert C.equivalent(a, b)
+    assert C.cmp(a, b) is C.Ordering.EQ
