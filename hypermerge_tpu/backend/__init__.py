@@ -1,0 +1,1 @@
+"""Backend layer: CRDT compute + orchestration (SURVEY.md §1.3)."""

@@ -1,0 +1,110 @@
+"""Actor — binds one feed to an in-memory Change list.
+
+Parity: reference src/Actor.ts:44-142 — writes local changes as packed
+blocks (seq continuity asserted against feed length), parses downloaded
+blocks back into changes, and emits lifecycle events
+(ActorInitialized / ActorSync / Download) to the RepoBackend hub.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..crdt.change import Change
+from ..storage import block as blockmod
+from ..storage.feed import Feed
+from ..utils.debug import log
+
+
+class Actor:
+    def __init__(
+        self,
+        feed: Feed,
+        notify: Callable[[Dict[str, Any]], None],
+    ) -> None:
+        self.id = feed.public_key
+        self.feed = feed
+        self._notify = notify
+        self._lock = threading.RLock()
+        self.changes: List[Optional[Change]] = []
+        self._load_existing()
+        feed.on_append(self._on_append)
+        self._notify({"type": "ActorInitialized", "actor": self})
+        self._notify({"type": "ActorSync", "actor": self})
+
+    @property
+    def writable(self) -> bool:
+        return self.feed.writable
+
+    @property
+    def seq_head(self) -> int:
+        with self._lock:
+            return len(self.changes)
+
+    def _load_existing(self) -> None:
+        for index, data in enumerate(self.feed.read_all()):
+            change = self._parse_block(data, index)
+            self.changes.append(change)
+
+    def _parse_block(self, data: bytes, index: int) -> Optional[Change]:
+        try:
+            return Change.from_json(blockmod.unpack(data))
+        except (ValueError, KeyError, TypeError) as e:
+            log("repo:actor", f"corrupt block {index} in {self.id[:6]}: {e}")
+            return None
+
+    def write_change(self, change: Change) -> None:
+        """Append a locally-generated change; seq must equal feed length+1
+        (per-actor total order invariant, reference src/Actor.ts:73-80)."""
+        with self._lock:
+            head = len(self.changes)
+            if change.seq != head + 1:
+                log(
+                    "repo:actor",
+                    f"seq mismatch on {self.id[:6]}: "
+                    f"{change.seq} != {head + 1}",
+                )
+                return
+            self.changes.append(change)
+            self.feed.append(blockmod.pack(change.to_json()))
+        # local writes don't re-notify sync: the doc already applied it
+
+    def deliver_remote_block(self, index: int, data: bytes) -> None:
+        """Replication path: a verified remote block arrives in order."""
+        t0 = time.perf_counter()
+        self.feed._append_raw(data)
+        self._notify(
+            {
+                "type": "Download",
+                "actor": self,
+                "index": index,
+                "size": len(data),
+                "time": (time.perf_counter() - t0) * 1e3,
+            }
+        )
+
+    def _on_append(self, index: int, data: bytes) -> None:
+        with self._lock:
+            if index < len(self.changes):
+                return  # our own write_change already recorded it
+            change = self._parse_block(data, index)
+            self.changes.append(change)
+        self._notify({"type": "ActorSync", "actor": self})
+
+    def changes_in_window(self, start_seq: int, end_seq: float) -> List[Change]:
+        """Changes with seq in (start_seq, end_seq] — the syncChanges
+        window (reference src/RepoBackend.ts:513-522). seqs are 1-based;
+        change at list index i has seq i+1."""
+        with self._lock:
+            end = min(len(self.changes), int(min(end_seq, len(self.changes))))
+            out = [
+                c
+                for c in self.changes[start_seq:end]
+                if c is not None
+            ]
+            return out
+
+    def close(self) -> None:
+        pass

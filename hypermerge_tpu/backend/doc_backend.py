@@ -1,0 +1,153 @@
+"""DocBackend — per-document CRDT state holder.
+
+Parity: reference src/DocBackend.ts:46-213 — wraps the CRDT engine
+(here: crdt.opset.OpSet), serializes local/remote change application
+through single-subscriber queues, tracks the clock and the minimumClock
+render gate (don't surface a doc until we've caught up to what peers said
+exists, reference src/DocBackend.ts:90-113), and notifies the RepoBackend
+hub of Ready/LocalPatch/RemotePatch events.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..crdt import clock as clockmod
+from ..crdt.change import Change, ChangeRequest
+from ..crdt.opset import OpSet
+from ..utils.debug import bench, log
+from ..utils.queue import Queue
+
+
+class DocBackend:
+    def __init__(
+        self,
+        doc_id: str,
+        notify: Callable[[Dict[str, Any]], None],
+        opset: Optional[OpSet] = None,
+    ) -> None:
+        self.id = doc_id
+        self._notify = notify
+        self._lock = threading.RLock()
+        self.opset: Optional[OpSet] = opset
+        self.actor_id: Optional[str] = None
+        self.device_snapshot = None  # set by bulk loader before Ready
+        self.ready = Queue(f"doc:{doc_id[:6]}:ready")
+        self._announced = False
+        self.minimum_clock: Optional[clockmod.Clock] = None
+        self.local_q: Queue = Queue(f"doc:{doc_id[:6]}:local")
+        self.remote_q: Queue = Queue(f"doc:{doc_id[:6]}:remote")
+        self.local_q.subscribe(self._handle_local)
+        self.remote_q.subscribe(self._handle_remote)
+        if opset is not None:
+            self._check_ready()
+
+    # ------------------------------------------------------------------
+
+    @property
+    def clock(self) -> clockmod.Clock:
+        with self._lock:
+            return dict(self.opset.clock) if self.opset else {}
+
+    @property
+    def history_len(self) -> int:
+        with self._lock:
+            return len(self.opset.history) if self.opset else 0
+
+    def init(self, changes: List[Change], actor_id: Optional[str]) -> None:
+        """Cold-start materialization (reference DocBackend.init — the
+        north-star hot loop's per-doc endpoint)."""
+        with self._lock:
+            if self.opset is None:
+                self.opset = OpSet()
+            with bench(f"doc:init"):
+                self.opset.apply_changes(changes)
+            if actor_id is not None:
+                self.actor_id = actor_id
+        self._check_ready()
+
+    def set_actor_id(self, actor_id: str) -> None:
+        with self._lock:
+            self.actor_id = actor_id
+        if self._announced:
+            self._notify(
+                {"type": "ActorId", "doc": self, "actorId": actor_id}
+            )
+
+    def apply_remote_changes(self, changes: List[Change]) -> None:
+        self.remote_q.push(list(changes))
+
+    def apply_local_request(self, req: ChangeRequest) -> None:
+        self.local_q.push(req)
+
+    def update_minimum_clock(self, clock: clockmod.Clock) -> None:
+        """Gate first render until we've caught up to this clock
+        (reference updateMinimumClock/testMinimumClockSatisfied)."""
+        with self._lock:
+            if self._announced:
+                return
+            self.minimum_clock = clockmod.union(
+                self.minimum_clock or {}, clock
+            )
+        self._check_ready()
+
+    def materialize_at(self, n: int):
+        with self._lock:
+            if self.opset is None:
+                return None
+            return self.opset.materialize_at(n)
+
+    def snapshot_patch(self):
+        with self._lock:
+            return self.opset.snapshot_patch() if self.opset else None
+
+    # ------------------------------------------------------------------
+
+    def _minimum_satisfied(self) -> bool:
+        if self.opset is None:
+            return False
+        if self.minimum_clock is None:
+            return True
+        return clockmod.gte(self.opset.clock, self.minimum_clock)
+
+    def _check_ready(self) -> None:
+        with self._lock:
+            if self._announced or not self._minimum_satisfied():
+                return
+            self._announced = True
+        log("doc:back", self.id[:6], "ready")
+        self._notify({"type": "DocReady", "doc": self})
+        self.ready.push(True)
+
+    def _handle_local(self, req: ChangeRequest) -> None:
+        with self._lock:
+            if self.opset is None:
+                self.opset = OpSet()
+            with bench("doc:applyLocalChange"):
+                try:
+                    change, patch = self.opset.apply_local_request(req)
+                except ValueError as e:
+                    log("doc:back", "rejected local change:", e)
+                    return
+        self._notify(
+            {
+                "type": "LocalPatch",
+                "doc": self,
+                "change": change,
+                "patch": patch,
+            }
+        )
+        self._check_ready()
+
+    def _handle_remote(self, changes: List[Change]) -> None:
+        with self._lock:
+            if self.opset is None:
+                self.opset = OpSet()
+            with bench("doc:applyRemoteChanges"):
+                patch = self.opset.apply_changes(changes)
+        if self._announced and not patch.is_empty:
+            self._notify(
+                {"type": "RemotePatch", "doc": self, "patch": patch}
+            )
+        self._check_ready()

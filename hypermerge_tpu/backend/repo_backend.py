@@ -1,0 +1,422 @@
+"""RepoBackend — the orchestration hub.
+
+Parity: reference src/RepoBackend.ts:55-651 — owns storage, doc backends,
+actors, cursor/clock stores; routes every event. Message protocol to the
+frontend is JSON dicts (msgs.py), so the frontend can live on another
+thread/process, and the batched XLA path can slot in behind the same seam
+(SURVEY.md §7.1).
+
+Bulk cold-start: `load_documents_bulk` packs many docs' feeds into one
+columnar batch and materializes them in a single device dispatch
+(ops/materialize.py) — the reference's per-doc loadDocument loop
+(src/RepoBackend.ts:238-257) becomes one XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import msgs
+from ..crdt import clock as clockmod
+from ..crdt.change import Change, ChangeRequest
+from ..crdt.opset import OpSet
+from ..storage.feed import (
+    FeedStore,
+    file_storage_fn,
+    memory_storage_fn,
+)
+from ..storage.sql import SqlDatabase
+from ..storage.stores import (
+    ClockStore,
+    CursorStore,
+    FeedInfoStore,
+    KeyStore,
+)
+from ..utils import keys as keymod
+from ..utils.debug import log
+from ..utils.ids import root_actor_id
+from ..utils.queue import Queue
+from .actor import Actor
+from .doc_backend import DocBackend
+
+
+class RepoBackend:
+    def __init__(
+        self, path: Optional[str] = None, memory: bool = False
+    ) -> None:
+        if not memory and path is None:
+            raise ValueError("need a path unless memory=True")
+        self.path = path
+        self.memory = memory
+        if memory:
+            storage_fn = memory_storage_fn
+            db_path = ":memory:"
+        else:
+            storage_fn = file_storage_fn(os.path.join(path, "feeds"))
+            os.makedirs(path, exist_ok=True)
+            db_path = os.path.join(path, "repo.db")
+        self.db = SqlDatabase(db_path)
+        self.clocks = ClockStore(self.db)
+        self.cursors = CursorStore(self.db)
+        self.key_store = KeyStore(self.db)
+        self.feed_info = FeedInfoStore(self.db)
+        self.feeds = FeedStore(storage_fn)
+        self.id: str = self.key_store.get_or_create("self.repo").public_key
+        self.docs: Dict[str, DocBackend] = {}
+        self.actors: Dict[str, Actor] = {}
+        self._lock = threading.RLock()
+        self.to_frontend: Queue = Queue("backend:toFrontend")
+        self._query_handlers: Dict[str, Callable] = {}
+        self.network = None  # attached by setSwarm (net/, M7)
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # wiring
+
+    def subscribe(self, subscriber: Callable[[Dict[str, Any]], None]) -> None:
+        self.to_frontend.subscribe(subscriber)
+
+    def receive(self, msg: Dict[str, Any]) -> None:
+        if self._closed:
+            return
+        t = msg["type"]
+        if t == "Create":
+            self.create(msg["publicKey"], msg["secretKey"])
+        elif t == "Open":
+            self.open(msg["id"])
+        elif t == "Request":
+            self.handle_request(msg["id"], msg["request"])
+        elif t == "Merge":
+            self.merge(msg["id"], clockmod.strs_to_clock(msg["actors"]))
+        elif t == "Close":
+            self.close_doc(msg["id"])
+        elif t == "Destroy":
+            self.destroy(msg["id"])
+        elif t == "DocMessage":
+            self.send_doc_message(msg["id"], msg["contents"])
+        elif t == "Query":
+            self.handle_query(msg["queryId"], msg["query"])
+        elif t == "NeedsActorId":
+            doc = self.docs.get(msg["id"])
+            if doc is not None:
+                self._ensure_writable_actor(doc)
+        else:
+            log("repo:backend", "unknown msg", t)
+
+    # ------------------------------------------------------------------
+    # doc lifecycle
+
+    def create(self, public_key: str, secret_key: str) -> DocBackend:
+        doc_id = public_key
+        doc = DocBackend(doc_id, self._doc_notify, None)
+        with self._lock:
+            self.docs[doc_id] = doc
+        self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
+        self._init_actor(keymod.KeyPair(public_key, secret_key))
+        doc.init([], doc_id)  # root actor is writable on create
+        return doc
+
+    def open(self, doc_id: str) -> DocBackend:
+        with self._lock:
+            doc = self.docs.get(doc_id)
+            if doc is not None:
+                if doc._announced:
+                    # a (re)opened frontend needs the Ready snapshot again
+                    self._send_ready(doc)
+                return doc
+            doc = DocBackend(doc_id, self._doc_notify, None)
+            self.docs[doc_id] = doc
+        self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
+        self._load_document(doc)
+        return doc
+
+    def merge(self, doc_id: str, clock: clockmod.Clock) -> None:
+        """Adopt the target clock's actors into this doc's cursor; actual
+        op merge falls out of sync_changes (reference src/RepoBackend.ts:
+        213-217)."""
+        self.open(doc_id)
+        self.cursors.update(self.id, doc_id, clock)
+        for actor_id in clock:
+            actor = self._get_or_create_actor(actor_id)
+            self._sync_changes(actor)
+
+    def close_doc(self, doc_id: str) -> None:
+        with self._lock:
+            self.docs.pop(doc_id, None)
+
+    def destroy(self, doc_id: str) -> None:
+        """Remove doc state from stores (the reference stubs this out —
+        src/RepoBackend.ts:632-635; we do the real cleanup)."""
+        self.close_doc(doc_id)
+        self.db.execute(
+            "DELETE FROM clocks WHERE repo_id=? AND doc_id=?",
+            (self.id, doc_id),
+        )
+        self.db.execute(
+            "DELETE FROM cursors WHERE repo_id=? AND doc_id=?",
+            (self.id, doc_id),
+        )
+
+    def handle_request(self, doc_id: str, request_json: Dict) -> None:
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            log("repo:backend", "request for unknown doc", doc_id[:6])
+            return
+        doc.apply_local_request(ChangeRequest.from_json(request_json))
+
+    # ------------------------------------------------------------------
+    # loading
+
+    def _load_document(self, doc: DocBackend) -> None:
+        cursor = self.cursors.get(self.id, doc.id)
+        changes: List[Change] = []
+        writable: Optional[str] = None
+        for actor_id, max_seq in cursor.items():
+            actor = self._get_or_create_actor(actor_id)
+            if actor.writable and writable is None:
+                writable = actor_id
+            changes.extend(actor.changes_in_window(0, max_seq))
+        if writable is None:
+            writable = self._create_doc_actor(doc.id)
+        root = root_actor_id(doc.id)
+        root_actor = self.actors.get(root)
+        if not changes and (root_actor is None or not root_actor.writable):
+            # Unknown doc with no local history: gate readiness until the
+            # root actor's first change replicates in (the reference's
+            # minimumClock render gate, src/DocBackend.ts:90-113)
+            doc.update_minimum_clock({root: 1})
+        doc.init(changes, writable)
+
+    def load_documents_bulk(self, doc_ids: List[str]) -> None:
+        """Cold-start many docs in ONE device dispatch: gather each doc's
+        feed changes, pack columnar, run the batched kernel, seed each
+        DocBackend's OpSet from the replayed history. The per-doc OpSet
+        still replays host-side for the interactive path, but readiness /
+        snapshot patches come straight from the device decode."""
+        from ..ops.materialize import materialize_batch, decode_patch
+
+        histories: List[List[Change]] = []
+        with_docs: List[DocBackend] = []
+        for doc_id in doc_ids:
+            with self._lock:
+                if doc_id in self.docs:
+                    continue
+                doc = DocBackend(doc_id, self._doc_notify, None)
+                self.docs[doc_id] = doc
+            self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
+            cursor = self.cursors.get(self.id, doc_id)
+            changes: List[Change] = []
+            for actor_id, max_seq in cursor.items():
+                actor = self._get_or_create_actor(actor_id)
+                changes.extend(actor.changes_in_window(0, max_seq))
+            histories.append(changes)
+            with_docs.append(doc)
+        if not histories:
+            return
+        dec = materialize_batch(histories)
+        for i, doc in enumerate(with_docs):
+            writable = self._writable_actor_for(doc.id)
+            doc.device_snapshot = decode_patch(dec, i)  # cached for Ready
+            doc.init(histories[i], writable)
+
+    def _writable_actor_for(self, doc_id: str) -> str:
+        cursor = self.cursors.get(self.id, doc_id)
+        for actor_id in cursor:
+            actor = self.actors.get(actor_id)
+            if actor is not None and actor.writable:
+                return actor_id
+        return self._create_doc_actor(doc_id)
+
+    def _create_doc_actor(self, doc_id: str) -> str:
+        pair = keymod.create()
+        self._init_actor(pair)
+        self.cursors.add_actor(self.id, doc_id, pair.public_key)
+        return pair.public_key
+
+    def _ensure_writable_actor(self, doc: DocBackend) -> None:
+        actor_id = self._writable_actor_for(doc.id)
+        doc.set_actor_id(actor_id)
+
+    # ------------------------------------------------------------------
+    # actors
+
+    def _init_actor(self, pair: keymod.KeyPair) -> Actor:
+        feed = self.feeds.create(pair)
+        actor = Actor(feed, self._actor_notify)
+        with self._lock:
+            self.actors[actor.id] = actor
+        self.feed_info.save(
+            feed.public_key, feed.discovery_id, feed.writable
+        )
+        if self.network is not None:
+            self.network.announce_feed(feed)
+        return actor
+
+    def _get_or_create_actor(self, actor_id: str) -> Actor:
+        with self._lock:
+            actor = self.actors.get(actor_id)
+        if actor is None:
+            feed = self.feeds.open_feed(actor_id)
+            actor = Actor(feed, self._actor_notify)
+            with self._lock:
+                self.actors[actor_id] = actor
+            self.feed_info.save(
+                feed.public_key, feed.discovery_id, feed.writable
+            )
+        return actor
+
+    def _sync_changes(self, actor: Actor) -> None:
+        """Feed caught new blocks: push the admissible window into every
+        doc whose cursor includes this actor (reference syncChanges,
+        src/RepoBackend.ts:506-531)."""
+        for doc_id in self.cursors.docs_with_actor(self.id, actor.id):
+            doc = self.docs.get(doc_id)
+            if doc is None or doc.opset is None:
+                continue
+            start = doc.clock.get(actor.id, 0)
+            end = self.cursors.entry(self.id, doc_id, actor.id)
+            window = actor.changes_in_window(start, end)
+            if window:
+                doc.apply_remote_changes(window)
+
+    # ------------------------------------------------------------------
+    # notifications from docs / actors
+
+    def _doc_notify(self, event: Dict[str, Any]) -> None:
+        t = event["type"]
+        doc: DocBackend = event["doc"]
+        if t == "DocReady":
+            self._send_ready(doc)
+        elif t == "LocalPatch":
+            change: Change = event["change"]
+            actor = self.actors.get(change.actor)
+            if actor is not None and actor.writable:
+                actor.write_change(change)
+            else:
+                log("repo:backend", "no writable actor for", change.actor[:6])
+            clock = doc.clock
+            self.clocks.update(self.id, doc.id, clock)
+            self.cursors.update(self.id, doc.id, {change.actor: change.seq})
+            self.to_frontend.push(
+                msgs.patch_msg(
+                    doc.id, event["patch"].to_json(), doc.history_len
+                )
+            )
+            self._gossip_cursor(doc)
+        elif t == "RemotePatch":
+            self.clocks.update(self.id, doc.id, doc.clock)
+            self.to_frontend.push(
+                msgs.patch_msg(
+                    doc.id, event["patch"].to_json(), doc.history_len
+                )
+            )
+        elif t == "ActorId":
+            self.to_frontend.push(
+                msgs.actor_id_msg(doc.id, event["actorId"])
+            )
+
+    def _send_ready(self, doc: DocBackend) -> None:
+        snapshot = getattr(doc, "device_snapshot", None)
+        patch = snapshot if snapshot is not None else doc.snapshot_patch()
+        doc.device_snapshot = None
+        self.clocks.update(self.id, doc.id, doc.clock)
+        self.to_frontend.push(
+            msgs.ready_msg(
+                doc.id,
+                doc.actor_id,
+                patch.to_json() if patch else None,
+                doc.history_len,
+            )
+        )
+
+    def _actor_notify(self, event: Dict[str, Any]) -> None:
+        t = event["type"]
+        actor: Actor = event["actor"]
+        if t == "ActorSync":
+            self._sync_changes(actor)
+        elif t == "Download":
+            for doc_id in self.cursors.docs_with_actor(self.id, actor.id):
+                self.to_frontend.push(
+                    msgs.download_msg(
+                        doc_id,
+                        actor.id,
+                        event["index"],
+                        event["size"],
+                        event["time"],
+                    )
+                )
+        # ActorInitialized: nothing extra — feeds announce via network hook
+
+    # ------------------------------------------------------------------
+    # queries
+
+    def handle_query(self, query_id: int, query: Dict[str, Any]) -> None:
+        t = query["type"]
+        if t == "Materialize":
+            doc = self.docs.get(query["id"])
+            if doc is None or doc.opset is None:
+                payload = None
+            else:
+                sub = OpSet()
+                sub.apply_changes(doc.opset.history[: query["history"]])
+                payload = sub.snapshot_patch().to_json()
+            self.to_frontend.push(msgs.reply_msg(query_id, payload))
+        elif t == "Metadata":
+            doc = self.docs.get(query["id"])
+            if doc is None:
+                payload = None
+            else:
+                payload = {
+                    "type": "Document",
+                    "clock": clockmod.clock_to_strs(doc.clock),
+                    "actors": self.cursors.actors_for(self.id, doc.id),
+                    "history": doc.history_len,
+                }
+            self.to_frontend.push(msgs.reply_msg(query_id, payload))
+        else:
+            self.to_frontend.push(msgs.reply_msg(query_id, None))
+
+    # ------------------------------------------------------------------
+    # peer messaging + gossip (fully wired by net/, M7)
+
+    def send_doc_message(self, doc_id: str, contents: Any) -> None:
+        if self.network is not None:
+            self.network.broadcast_doc_message(doc_id, contents)
+
+    def deliver_doc_message(self, doc_id: str, contents: Any) -> None:
+        """Inbound ephemeral message from a peer."""
+        self.to_frontend.push(msgs.doc_message_fwd_msg(doc_id, contents))
+
+    def _gossip_cursor(self, doc: DocBackend) -> None:
+        if self.network is not None:
+            self.network.gossip_cursor(
+                doc.id,
+                self.cursors.get(self.id, doc.id),
+                self.clocks.get(self.id, doc.id),
+            )
+
+    def start_file_server(self, path: str) -> None:
+        from ..files.file_server import FileServer  # files subsystem
+
+        self._file_server = FileServer(self)
+        self._file_server.listen(path)
+        self.to_frontend.push(msgs.file_server_ready_msg(path))
+
+    def set_swarm(self, swarm) -> None:
+        from ..net.network import Network  # local import: net dep optional
+
+        if self.network is None:
+            self.network = Network(self)
+        self.network.set_swarm(swarm)
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        if self.network is not None:
+            self.network.close()
+        self.feeds.close()
+        self.db.close()

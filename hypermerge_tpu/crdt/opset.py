@@ -162,14 +162,24 @@ class OpSet:
             obj_id = temp_map[intent.obj]
         elif intent.obj == ROOT_STR or intent.obj == "_root":
             obj_id = ROOT
+        elif intent.obj.startswith("tmp:"):
+            return None  # references a temp id whose MAKE failed
         else:
-            obj_id = OpId.parse(intent.obj)
+            try:
+                obj_id = OpId.parse(intent.obj)
+            except ValueError:
+                return None
         obj = self.objects.get(obj_id)
         if obj is None:
             return None
-        if intent.temp_id is not None:
+        op = self._build_intent_op(intent, obj_id, obj)
+        if op is not None and intent.temp_id is not None:
+            # register only on success: a failed intent must not alias its
+            # temp id onto the OpId the next successful op will consume
             temp_map[intent.temp_id] = opid
+        return op
 
+    def _build_intent_op(self, intent, obj_id: OpId, obj: _Obj) -> Optional[Op]:
         action = intent.action
         if obj.is_sequence:
             if intent.insert:
@@ -405,7 +415,10 @@ class OpSet:
             )
             diffs.append(
                 Diff(
-                    action="set",
+                    # a tombstoned element coming back to life (concurrent
+                    # set vs delete) is an *insert* from the frontend's
+                    # point of view — it removed the elem already
+                    action="set" if had else "insert",
                     obj=str(op.obj),
                     obj_type=obj.type,
                     index=live_index,
@@ -505,6 +518,11 @@ class OpSet:
                 if op.action.makes_object:
                     self._snapshot_obj(winner, diffs)
                 value, link, datatype = self._op_value(winner, op)
+                conflicts = tuple(
+                    Conflict(str(oid), *self._op_value(oid, visible[oid]))
+                    for oid in sorted(visible, reverse=True)
+                    if oid != winner
+                )
                 diffs.append(
                     Diff(
                         action="insert",
@@ -515,6 +533,7 @@ class OpSet:
                         value=value,
                         link=link,
                         datatype=datatype,
+                        conflicts=conflicts,
                     )
                 )
                 index += 1

@@ -1,0 +1,144 @@
+"""DocFrontend — per-doc materialized state and change entry point.
+
+Parity: reference src/DocFrontend.ts:23-192 — mode state machine
+(pending -> read -> write), change fns queued until an actor id exists,
+patches applied to the materialized state, new states fanned out to every
+handle. The «blank -> preview -> final» sequence subscribers observe
+matches the reference's change flow (src/DocFrontend.ts:135-150).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..crdt.frontend_state import FrontendDoc
+from ..crdt.patch import Patch
+from ..utils.debug import bench, log
+from ..utils.ids import to_doc_url
+from .handle import Handle
+
+
+class DocFrontend:
+    def __init__(self, repo_frontend, doc_id: str,
+                 actor_id: Optional[str] = None) -> None:
+        self._repo = repo_frontend
+        self.doc_id = doc_id
+        self.url = to_doc_url(doc_id)
+        self.actor_id = actor_id
+        self.mode = "pending" if actor_id is None else "write"
+        self.front = FrontendDoc()
+        self.seq = 1
+        self.history = 0
+        self._handles: List[Handle] = []
+        self._change_queue: List[tuple] = []
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+
+    def handle(self) -> Handle:
+        h = Handle(self)
+        with self._lock:
+            self._handles.append(h)
+            if self.mode != "pending":
+                h.push(self.front.materialize(), self.history)
+        return h
+
+    def release_handle(self, h: Handle) -> None:
+        with self._lock:
+            if h in self._handles:
+                self._handles.remove(h)
+
+    def change(self, fn: Callable[[Any], None], message: str = "") -> None:
+        with self._lock:
+            if self.mode == "pending" or self.actor_id is None:
+                self._change_queue.append((fn, message))
+                self._repo.needs_actor(self.doc_id)
+                return
+        self._run_change(fn, message)
+
+    def _run_change(self, fn: Callable, message: str) -> None:
+        with self._lock:
+            with bench("front:change"):
+                request, preview = self.front.change(
+                    fn, self.actor_id, self.seq, message
+                )
+            if request is None:
+                return
+            self.seq += 1
+        self._fan_out(preview)  # «change preview»
+        self._repo.send_request(self.doc_id, request)
+
+    def send_doc_message(self, contents: Any) -> None:
+        self._repo.send_doc_message(self.doc_id, contents)
+
+    # ------------------------------------------------------------------
+    # backend messages
+
+    def on_ready(
+        self,
+        actor_id: Optional[str],
+        patch_json: Optional[Dict],
+        history: int,
+    ) -> None:
+        with self._lock:
+            if patch_json is not None:
+                with bench("front:patch"):
+                    self.front.apply_patch(Patch.from_json(patch_json))
+            if actor_id is not None:
+                self.actor_id = actor_id
+                self.seq = self.front.clock.get(actor_id, 0) + 1
+            self.history = history
+            was_pending = self.mode == "pending"
+            self.mode = "write" if self.actor_id else "read"
+            queued = list(self._change_queue)
+            self._change_queue.clear()
+        if was_pending or patch_json is not None:
+            self._fan_out(self.front.materialize())
+        for fn, message in queued:
+            self._run_change(fn, message)
+
+    def on_actor_id(self, actor_id: str) -> None:
+        with self._lock:
+            self.actor_id = actor_id
+            self.seq = self.front.clock.get(actor_id, 0) + 1
+            self.mode = "write"
+            queued = list(self._change_queue)
+            self._change_queue.clear()
+        for fn, message in queued:
+            self._run_change(fn, message)
+
+    def on_patch(self, patch_json: Dict, history: int) -> None:
+        with self._lock:
+            patch = Patch.from_json(patch_json)
+            with bench("front:patch"):
+                self.front.apply_patch(patch)
+            self.history = history
+            if patch.is_empty:
+                return
+        self._fan_out(self.front.materialize())  # «change final» echo
+
+    def on_message(self, contents: Any) -> None:
+        with self._lock:
+            handles = list(self._handles)
+        for h in handles:
+            h.push_message(contents)
+
+    def on_progress(self, progress: Dict) -> None:
+        with self._lock:
+            handles = list(self._handles)
+        for h in handles:
+            h.push_progress(progress)
+
+    # ------------------------------------------------------------------
+
+    def _fan_out(self, state: Any) -> None:
+        with self._lock:
+            handles = list(self._handles)
+            history = self.history
+        for h in handles:
+            h.push(state, history)
+
+    @property
+    def clock(self):
+        return dict(self.front.clock)

@@ -1,0 +1,232 @@
+"""Batched CRDT materialization kernels — the hot loop on device.
+
+Computes, for a whole batch of documents at once, everything Automerge's
+`Backend.applyChanges` full-replay produces (the reference's cold-start hot
+loop, SURVEY.md §3.3), as one fused XLA program over the columnar encoding
+(ops/columnar.py):
+
+1. supersession: pred edges scatter a `dead` mask (observed-remove)
+2. counter totals: INC deltas segment-sum onto live counter ops
+3. LWW map winners: lexsort by (group, lamport) + run boundaries
+4. RGA element order: one forest over all list/text objects — sibling sort
+   (parent asc, OpId desc), preorder-successor via pointer-doubling climb,
+   Wyllie list-ranking for positions. All data-dependent chasing is
+   log2(N) rounds of gathers — no scalar loops, TPU/XLA friendly.
+5. element liveness + winner value op per element (scatter-max)
+6. per-doc vector clock (scatter-max of seq per actor)
+
+Everything is `vmap`ed over the leading doc axis and jit-cached per
+(N, P, A, K) bucket. The doc axis is the `dp` sharding axis (parallel/).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..crdt.change import Action
+from .columnar import PAD, ColumnarBatch
+
+_SET = int(Action.SET)
+_DEL = int(Action.DEL)
+_INC = int(Action.INC)
+_MAKE_LIST = int(Action.MAKE_LIST)
+_MAKE_TEXT = int(Action.MAKE_TEXT)
+
+
+class MaterializeOut(NamedTuple):
+    """Per-row outputs, shape [D, N] unless noted."""
+
+    dead: jax.Array  # bool: superseded by some pred edge
+    visible: jax.Array  # bool: value op (SET/MAKE) still visible
+    map_winner: jax.Array  # bool: the winning visible op of its (obj, key)
+    elem_winner: jax.Array  # bool: winning visible value op of its element
+    elem_live: jax.Array  # bool (INS rows): element has a visible value
+    rank: jax.Array  # int32: RGA order key (higher = earlier in list)
+    inc_total: jax.Array  # int32: accumulated INC deltas per value op
+    clock: jax.Array  # [D, A] int32 vector clock
+
+
+def _ceil_log2(n: int) -> int:
+    return max(1, math.ceil(math.log2(max(n, 2))))
+
+
+def _doc_kernel(
+    action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
+    *, A: int, K: int,
+):
+    N = action.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    valid = action != PAD
+    is_make = (action <= 3) & valid
+    is_set = (action == _SET) & valid
+    is_ins = (insert == 1) & valid
+
+    # -- 1. supersession ------------------------------------------------
+    tgt = jnp.where(ptgt >= 0, ptgt, N)
+    dead = jnp.zeros(N + 1, dtype=bool).at[tgt].set(True)[:N]
+    visible = (is_make | is_set) & ~dead
+
+    # -- 2. counter increments -----------------------------------------
+    is_inc = (action == _INC) & valid
+    inc_tgt = jnp.clip(ref, 0, N - 1)
+    inc_ok = is_inc & (ref >= 0) & ~dead[inc_tgt]
+    inc_total = (
+        jnp.zeros(N + 1, dtype=jnp.int32)
+        .at[jnp.where(inc_ok, inc_tgt, N)]
+        .add(jnp.where(inc_ok, value, 0))[:N]
+    )
+
+    # -- 3. LWW map winners --------------------------------------------
+    # group id over (obj, key); 0 = not a map-located value op
+    in_map = visible & (key >= 0)
+    gid = jnp.where(in_map, (obj + 1) * (K + 1) + (key + 1), 0)
+    order = jnp.lexsort((actor, ctr, gid))
+    g_sorted = gid[order]
+    run_end = jnp.concatenate(
+        [g_sorted[1:] != g_sorted[:-1], jnp.ones((1,), dtype=bool)]
+    )
+    winner_sorted = run_end & (g_sorted > 0)
+    map_winner = jnp.zeros(N, dtype=bool).at[order].set(winner_sorted)
+
+    # -- 4. element values: winner per element -------------------------
+    # OpId composite; +1 so 0 means "no visible value"
+    comp = ctr * jnp.int32(A) + actor + 1
+    is_elem_update = visible & ~is_ins & (key < 0) & (ref >= 0)
+    own_value = visible & is_ins
+    contrib = is_elem_update | own_value
+    elem_of = jnp.where(is_elem_update, ref, jnp.where(own_value, idx, N))
+    best = (
+        jnp.zeros(N + 1, dtype=jnp.int32)
+        .at[elem_of]
+        .max(jnp.where(contrib, comp, 0))[:N]
+    )
+    elem_live = is_ins & (best > 0)
+    elem_winner = contrib & (
+        comp == best[jnp.clip(elem_of, 0, N - 1)]
+    )
+
+    # -- 5. RGA forest order -------------------------------------------
+    is_seq_container = ((action == _MAKE_LIST) | (action == _MAKE_TEXT)) & valid
+    in_forest = is_ins | is_seq_container
+    # parent: INS -> predecessor elem (HEAD -> the container row);
+    # non-inserted containers are tree roots (-1)
+    parent = jnp.where(
+        is_ins, jnp.where(ref == -2, obj, ref), jnp.int32(-1)
+    )
+    # sibling sort: group by parent (asc), OpId descending within group
+    pa = jnp.where(in_forest, parent + 1, N + 1)
+    inv = jnp.int32(2**30) - comp
+    order2 = jnp.lexsort((inv, pa))
+    pa_s = pa[order2]
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), dtype=bool), pa_s[1:] != pa_s[:-1]]
+    )
+    fc_table = (
+        jnp.full(N + 2, -1, dtype=jnp.int32)
+        .at[jnp.where(run_start, pa_s, N + 1)]
+        .set(jnp.where(run_start, order2, -1).astype(jnp.int32))
+    )
+    first_child = fc_table[idx + 1]  # children of node i have pa == i+1
+    nxt_in_sort = jnp.concatenate([order2[1:], jnp.full((1,), -1, jnp.int32)])
+    same_parent = jnp.concatenate(
+        [pa_s[1:] == pa_s[:-1], jnp.zeros((1,), dtype=bool)]
+    )
+    nsib = (
+        jnp.full(N, -1, dtype=jnp.int32)
+        .at[order2]
+        .set(jnp.where(same_parent, nxt_in_sort, -1).astype(jnp.int32))
+    )
+
+    # climb-to-sibling fixpoint via pointer doubling (terminal = N)
+    has_sib = nsib != -1
+    jump = jnp.where(
+        has_sib, idx, jnp.where(parent >= 0, parent, N)
+    ).astype(jnp.int32)
+    jump = jnp.where(in_forest, jump, N)
+    jump_ext = jnp.concatenate([jump, jnp.array([N], jnp.int32)])
+    for _ in range(_ceil_log2(N) + 1):
+        jump_ext = jump_ext[jump_ext]
+    fix = jump_ext[:N]
+    nsib_ext = jnp.concatenate([nsib, jnp.array([-1], jnp.int32)])
+    succ = jnp.where(first_child != -1, first_child, nsib_ext[fix])
+    succ = jnp.where(in_forest, succ, -1)
+    nxt = jnp.where(succ == -1, N, succ).astype(jnp.int32)
+
+    # Wyllie list-ranking: rank = #nodes from here to end of chain
+    rank = jnp.where(in_forest, 1, 0).astype(jnp.int32)
+    rank_ext = jnp.concatenate([rank, jnp.zeros((1,), jnp.int32)])
+    nxt_ext = jnp.concatenate([nxt, jnp.array([N], jnp.int32)])
+    for _ in range(_ceil_log2(N) + 1):
+        rank_ext = rank_ext + rank_ext[nxt_ext]
+        nxt_ext = nxt_ext[nxt_ext]
+    rank = rank_ext[:N]
+
+    # -- 6. clock -------------------------------------------------------
+    clock = (
+        jnp.zeros(A, dtype=jnp.int32)
+        .at[jnp.where(valid, actor, 0)]
+        .max(jnp.where(valid, seq, 0))
+    )
+
+    return MaterializeOut(
+        dead=dead,
+        visible=visible,
+        map_winner=map_winner,
+        elem_winner=elem_winner,
+        elem_live=elem_live,
+        rank=rank,
+        inc_total=inc_total,
+        clock=clock,
+    )
+
+
+@partial(jax.jit, static_argnames=("A", "K"))
+def materialize_device(
+    action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
+    A: int, K: int,
+) -> MaterializeOut:
+    """Batched kernel: all args [D, N] (pred edges [D, P])."""
+    return jax.vmap(
+        lambda *xs: _doc_kernel(*xs, A=A, K=K)
+    )(action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt)
+
+
+def run_batch(batch: ColumnarBatch) -> MaterializeOut:
+    """Convenience host entry: pack numpy -> device -> outputs."""
+    A = max(1, len(batch.actors))
+    K = len(batch.keys)
+    c = batch.cols
+    _check_ranges(batch, A, K)
+    return materialize_device(
+        jnp.asarray(c["action"]),
+        jnp.asarray(c["actor"]),
+        jnp.asarray(c["ctr"]),
+        jnp.asarray(c["seq"]),
+        jnp.asarray(c["obj"]),
+        jnp.asarray(c["key"]),
+        jnp.asarray(c["ref"]),
+        jnp.asarray(c["insert"]),
+        jnp.asarray(c["value"]),
+        jnp.asarray(batch.psrc),
+        jnp.asarray(batch.ptgt),
+        A=A,
+        K=K,
+    )
+
+
+def _check_ranges(batch: ColumnarBatch, A: int, K: int) -> None:
+    import numpy as np
+
+    N = batch.n_rows
+    max_ctr = int(batch.cols["ctr"].max(initial=0))
+    if max_ctr * A + A >= 2**30:
+        raise ValueError(
+            f"lamport x actor composite overflow: ctr={max_ctr} A={A}"
+        )
+    if (N + 1) * (K + 1) + K >= 2**31:
+        raise ValueError(f"obj x key group id overflow: N={N} K={K}")

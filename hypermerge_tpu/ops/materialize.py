@@ -1,0 +1,269 @@
+"""Bulk materialization: device kernel outputs -> patches / documents.
+
+This is the cold-start path of the dual-path design (SURVEY.md §3.3: the
+reference replays every change through Backend.applyChanges per doc; here
+thousands of docs replay in one XLA dispatch via ops/crdt_kernels.py and
+this module turns the winner/order/liveness lanes back into:
+
+- `decode_patch`: a snapshot Patch identical in meaning to
+  OpSet.snapshot_patch() — feeds DocReady messages to frontends.
+- `materialize_docs`: plain Python document trees (equivalence-tested
+  against the host OpSet path).
+- `decode_columnar`: stays in numpy — the representation bulk consumers
+  (bench, ClockStore-scale queries) should prefer; no per-entry Python
+  objects.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..crdt.change import Action
+from ..crdt.frontend_state import FrontendDoc
+from ..crdt.patch import Conflict, Diff, Patch
+from .columnar import ColumnarBatch, decode_value
+from .crdt_kernels import MaterializeOut, run_batch
+
+_OBJ_TYPES = {
+    int(Action.MAKE_MAP): "map",
+    int(Action.MAKE_LIST): "list",
+    int(Action.MAKE_TEXT): "text",
+    int(Action.MAKE_TABLE): "table",
+}
+
+ROOT_ROW = -1
+ROOT_ID = "0@_root"
+
+
+class DecodedBatch:
+    """Numpy views of device outputs, shared by the decoders."""
+
+    def __init__(self, batch: ColumnarBatch, out: MaterializeOut) -> None:
+        self.batch = batch
+        self.cols = {k: np.asarray(v) for k, v in batch.cols.items()}
+        self.visible = np.asarray(out.visible)
+        self.map_winner = np.asarray(out.map_winner)
+        self.elem_winner = np.asarray(out.elem_winner)
+        self.elem_live = np.asarray(out.elem_live)
+        self.rank = np.asarray(out.rank)
+        self.inc_total = np.asarray(out.inc_total)
+        self.clock = np.asarray(out.clock)
+
+    def clock_dict(self, d: int) -> Dict[str, int]:
+        return {
+            self.batch.actors[a]: int(s)
+            for a, s in enumerate(self.clock[d])
+            if s > 0
+        }
+
+
+def materialize_batch(
+    docs_changes, n_rows: Optional[int] = None
+) -> DecodedBatch:
+    """Pack -> device kernel -> decoded views, in one call."""
+    from .columnar import pack_docs
+
+    batch = pack_docs(docs_changes, n_rows=n_rows)
+    out = run_batch(batch)
+    return DecodedBatch(batch, out)
+
+
+# ---------------------------------------------------------------------------
+# per-doc patch decode (runtime use: DocReady snapshots)
+
+
+def decode_patch(dec: DecodedBatch, d: int) -> Patch:
+    b, c = dec.batch, dec.cols
+    action = c["action"][d]
+    actor = c["actor"][d]
+    ctr = c["ctr"][d]
+    obj = c["obj"][d]
+    key = c["key"][d]
+    ref = c["ref"][d]
+    insert = c["insert"][d]
+    vkind = c["vkind"][d]
+    value = c["value"][d]
+    dt = c["dt"][d]
+    visible = dec.visible[d]
+    map_winner = dec.map_winner[d]
+    elem_winner = dec.elem_winner[d]
+    elem_live = dec.elem_live[d]
+    rank = dec.rank[d]
+    inc_total = dec.inc_total[d]
+
+    def opid_str(row: int) -> str:
+        return f"{int(ctr[row])}@{b.actors[int(actor[row])]}"
+
+    def obj_id_str(row: int) -> str:
+        return ROOT_ID if row == ROOT_ROW else opid_str(row)
+
+    def row_value(row: int) -> Tuple[Any, bool, Optional[str]]:
+        a = int(action[row])
+        if a in _OBJ_TYPES:
+            return opid_str(row), True, None
+        v = decode_value(int(vkind[row]), int(value[row]), int(dt[row]), b)
+        datatype = (
+            "counter" if dt[row] == 1
+            else "timestamp" if dt[row] == 2 else None
+        )
+        if datatype == "counter":
+            v = (v or 0) + int(inc_total[row])
+        return v, False, datatype
+
+    # group winners/conflicts by container
+    map_rows_by_obj: Dict[int, List[int]] = {}
+    map_conf: Dict[Tuple[int, int], List[int]] = {}
+    for r in np.nonzero(visible & (key >= 0))[0]:
+        r = int(r)
+        if map_winner[r]:
+            map_rows_by_obj.setdefault(int(obj[r]), []).append(r)
+        else:
+            map_conf.setdefault((int(obj[r]), int(key[r])), []).append(r)
+
+    # elements: live INS rows per container, ordered by descending rank
+    elems_by_obj: Dict[int, List[int]] = {}
+    for r in np.nonzero(elem_live)[0]:
+        elems_by_obj.setdefault(int(obj[int(r)]), []).append(int(r))
+    for rows in elems_by_obj.values():
+        rows.sort(key=lambda r: -int(rank[r]))
+
+    # winner value op per element + conflicts
+    elem_val: Dict[int, int] = {}
+    elem_conf: Dict[int, List[int]] = {}
+    for r in np.nonzero(visible & (insert == 0) & (key < 0) & (ref >= 0))[0]:
+        r = int(r)
+        e = int(ref[r])
+        if elem_winner[r]:
+            elem_val[e] = r
+        else:
+            elem_conf.setdefault(e, []).append(r)
+    for r in np.nonzero(elem_live & elem_winner)[0]:
+        elem_val.setdefault(int(r), int(r))
+    for r in np.nonzero(visible & (insert == 1))[0]:
+        r = int(r)
+        if elem_live[r] and not elem_winner[r]:
+            elem_conf.setdefault(r, []).append(r)
+
+    diffs: List[Diff] = []
+    visited = set()
+
+    def conflicts_for(rows: List[int]) -> tuple:
+        # descending OpId = (ctr, actor-string) order, matching OpSet
+        ordered = sorted(
+            rows,
+            key=lambda r: (int(ctr[r]), b.actors[int(actor[r])]),
+            reverse=True,
+        )
+        out = []
+        for r in ordered:
+            v, link, datatype = row_value(r)
+            out.append(Conflict(opid_str(r), v, link, datatype))
+        return tuple(out)
+
+    def emit_obj(row: int) -> None:
+        if row in visited:
+            return
+        visited.add(row)
+        oid = obj_id_str(row)
+        otype = "map" if row == ROOT_ROW else _OBJ_TYPES[int(action[row])]
+        if row != ROOT_ROW:
+            diffs.append(Diff(action="create", obj=oid, obj_type=otype))
+        if otype in ("list", "text"):
+            for index, e in enumerate(elems_by_obj.get(row, [])):
+                w = elem_val[e]
+                v, link, datatype = row_value(w)
+                if link:
+                    emit_obj(w)
+                diffs.append(
+                    Diff(
+                        action="insert",
+                        obj=oid,
+                        obj_type=otype,
+                        index=index,
+                        elem_id=opid_str(e),
+                        value=v,
+                        link=link,
+                        datatype=datatype,
+                        conflicts=conflicts_for(
+                            [r for r in elem_conf.get(e, []) if r != w]
+                        ),
+                    )
+                )
+        else:
+            rows = map_rows_by_obj.get(row, [])
+            rows.sort(key=lambda r: b.keys[int(key[r])])
+            for w in rows:
+                v, link, datatype = row_value(w)
+                if link:
+                    emit_obj(w)
+                diffs.append(
+                    Diff(
+                        action="set",
+                        obj=oid,
+                        obj_type=otype,
+                        key=b.keys[int(key[w])],
+                        value=v,
+                        link=link,
+                        datatype=datatype,
+                        conflicts=conflicts_for(
+                            map_conf.get((row, int(key[w])), [])
+                        ),
+                    )
+                )
+
+    emit_obj(ROOT_ROW)
+    clock = dec.clock_dict(d)
+    max_op = int(ctr.max(initial=0))
+    return Patch(clock=clock, deps=clock, max_op=max_op, diffs=tuple(diffs))
+
+
+def materialize_docs(dec: DecodedBatch) -> List[Any]:
+    """Plain Python trees for every doc in the batch (test/equivalence
+    path; bulk consumers should stay columnar via decode_columnar)."""
+    out = []
+    for d in range(dec.batch.n_docs):
+        front = FrontendDoc()
+        front.apply_patch(decode_patch(dec, d))
+        out.append(front.materialize())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# columnar decode (bench / bulk path — no per-entry Python objects)
+
+
+def decode_columnar(dec: DecodedBatch) -> Dict[str, np.ndarray]:
+    """Vectorized summary of materialized state: winner masks, element
+    order keys, clocks. This is the 'materialized' form bulk pipelines
+    consume (and what the 10k-doc bench measures end-to-end)."""
+    live_elems = dec.elem_live
+    order_key = np.where(live_elems, -dec.rank, np.iinfo(np.int32).max)
+    elem_order = np.argsort(order_key, axis=1, kind="stable")
+    return {
+        "map_winner": dec.map_winner,
+        "elem_live": live_elems,
+        "elem_order": elem_order,
+        "n_live_elems": live_elems.sum(axis=1),
+        "n_map_entries": dec.map_winner.sum(axis=1),
+        "clock": dec.clock,
+    }
+
+
+def text_join(dec: DecodedBatch, d: int, text_obj_row: int) -> str:
+    """Fast text materialization: join the winner chars of one text object
+    in RGA order (numpy sort, no per-char Python)."""
+    c = dec.cols
+    mask = (
+        dec.elem_live[d]
+        & (c["obj"][d] == text_obj_row)
+        & (c["insert"][d] == 1)
+    )
+    rows = np.nonzero(mask)[0]
+    rows = rows[np.argsort(-dec.rank[d][rows], kind="stable")]
+    strings = dec.batch.strings
+    return "".join(
+        strings[c["value"][d][r]] if c["vkind"][d][r] == 3 else ""
+        for r in rows
+    )

@@ -1,0 +1,93 @@
+"""Repo — the facade binding one RepoFrontend and one RepoBackend.
+
+Parity: reference src/Repo.ts:11-58 — wires the two halves with mutual
+subscribe and re-exports their methods. Here both halves live in-process;
+the message protocol between them is plain dicts, so either half can be
+moved across a thread/process boundary without API changes (the
+reference's stated design goal, README.md:160-184).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .backend.repo_backend import RepoBackend
+from .frontend.handle import Handle
+from .frontend.repo_frontend import RepoFrontend
+from .utils.ids import DocUrl
+
+
+class Repo:
+    def __init__(
+        self, path: Optional[str] = None, memory: bool = False
+    ) -> None:
+        self.front = RepoFrontend()
+        self.back = RepoBackend(path=path, memory=memory)
+        self.front.subscribe(self.back.receive)
+        self.back.subscribe(self.front.receive)
+
+    # -- identity -------------------------------------------------------
+
+    @property
+    def id(self) -> str:
+        return self.back.id
+
+    # -- doc api (delegated to the frontend) ---------------------------
+
+    def create(self, init: Optional[dict] = None) -> DocUrl:
+        return self.front.create(init)
+
+    def open(self, url: str) -> Handle:
+        return self.front.open(url)
+
+    def doc(self, url: str, cb: Optional[Callable] = None) -> Any:
+        return self.front.doc(url, cb)
+
+    def watch(self, url: str, cb: Callable[[Any, int], None]) -> Handle:
+        return self.front.watch(url, cb)
+
+    def change(
+        self, url: str, fn: Callable[[Any], None], message: str = ""
+    ) -> None:
+        self.front.change(url, fn, message)
+
+    def merge(self, url: str, target: str) -> None:
+        self.front.merge(url, target)
+
+    def fork(self, url: str) -> DocUrl:
+        return self.front.fork(url)
+
+    def materialize(
+        self, url: str, history: int, cb: Callable[[Any], None]
+    ) -> None:
+        self.front.materialize(url, history, cb)
+
+    def meta(self, url: str, cb: Callable[[Any], None]) -> None:
+        self.front.meta(url, cb)
+
+    def message(self, url: str, contents: Any) -> None:
+        self.front.message(url, contents)
+
+    def close_doc(self, url: str) -> None:
+        self.front.close_doc(url)
+
+    def destroy(self, url: str) -> None:
+        self.front.destroy(url)
+
+    def debug(self, url: str) -> dict:
+        return self.front.debug(url)
+
+    # -- infrastructure -------------------------------------------------
+
+    @property
+    def files(self):
+        return self.front.files
+
+    def set_swarm(self, swarm) -> None:
+        self.back.set_swarm(swarm)
+
+    def start_file_server(self, path: str) -> None:
+        self.back.start_file_server(path)
+
+    def close(self) -> None:
+        self.back.close()

@@ -1,0 +1,1 @@
+"""Storage layer: append-only signed feeds, block codec, durable stores."""

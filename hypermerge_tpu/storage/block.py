@@ -1,0 +1,33 @@
+"""Change-block codec: JSON + compression with a tagged header.
+
+Parity target: the reference packs each change as brotli-compressed JSON
+with a 2-byte magic header and falls back to raw JSON when compression
+doesn't help, sniffing `{` for legacy blocks (reference src/Block.ts:6-29).
+
+This codec uses zlib ('ZL' header) — available without native deps — and
+the native/ C++ extension can register a brotli-class codec under a new
+header byte-pair without breaking stored feeds (the header dispatches).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any
+
+from ..utils.json_buffer import bufferify, parse
+
+_ZLIB_MAGIC = b"ZL"
+
+
+def pack(obj: Any) -> bytes:
+    raw = bufferify(obj)
+    compressed = zlib.compress(raw, level=6)
+    if len(compressed) + 2 < len(raw):
+        return _ZLIB_MAGIC + compressed
+    return raw  # incompressible: store raw JSON (starts with '{' or '[')
+
+
+def unpack(data: bytes) -> Any:
+    if data[:2] == _ZLIB_MAGIC:
+        return parse(zlib.decompress(data[2:]))
+    return parse(data)

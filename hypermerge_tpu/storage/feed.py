@@ -1,0 +1,242 @@
+"""Append-only per-actor feeds + FeedStore.
+
+Parity: the hypercore feed + FeedStore surface the reference relies on
+(SURVEY.md §2.1 FeedStore; src/types/hypercore.d.ts append/get/getBatch/
+stream/on('download'/'sync')). Design differences, TPU-first:
+
+- A feed is a block log with a signed merkle root per append (signing in
+  storage/integrity.py; writable feeds hold the secret key — feed identity
+  IS the ed25519 public key, like the reference).
+- Storage backends are pluggable like random-access-* (reference
+  src/RepoBackend.ts:84): MemoryFeedStorage and FileFeedStorage.
+- Readers can subscribe to appends (replication + Actor block parsing).
+
+The columnar bulk loader (ops/columnar.py) reads whole feeds at once for
+the batched cold-start path — `read_all` is the API it uses.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..utils import keys as keymod
+from ..utils.ids import DiscoveryId, get_or_create
+from ..utils.queue import Queue
+
+
+class MemoryFeedStorage:
+    def __init__(self) -> None:
+        self.blocks: List[bytes] = []
+
+    def append(self, data: bytes) -> None:
+        self.blocks.append(data)
+
+    def get(self, index: int) -> bytes:
+        return self.blocks[index]
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    def close(self) -> None:  # pragma: no cover - nothing to do
+        pass
+
+
+class FileFeedStorage:
+    """Length-prefixed block log + in-memory offset index.
+
+    Crash-safety model matches the reference's append-only philosophy
+    (SURVEY.md §5 failure detection): a torn tail write is detected by the
+    length prefix running past EOF and the tail is ignored — the same
+    self-healing the reference applies to holey feeds
+    (reference src/hypercore.ts:39-47)."""
+
+    _HDR = struct.Struct("<I")
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._offsets: List[int] = []
+        self._sizes: List[int] = []
+        self._fh = open(path, "ab+")
+        self._scan()
+
+    def _scan(self) -> None:
+        self._fh.seek(0, os.SEEK_END)
+        end = self._fh.tell()
+        pos = 0
+        self._fh.seek(0)
+        while pos + self._HDR.size <= end:
+            (size,) = self._HDR.unpack(self._fh.read(self._HDR.size))
+            if pos + self._HDR.size + size > end:
+                break  # torn tail: ignore
+            self._offsets.append(pos + self._HDR.size)
+            self._sizes.append(size)
+            pos += self._HDR.size + size
+            self._fh.seek(pos)
+
+    def append(self, data: bytes) -> None:
+        self._fh.seek(0, os.SEEK_END)
+        pos = self._fh.tell()
+        self._fh.write(self._HDR.pack(len(data)))
+        self._fh.write(data)
+        self._fh.flush()
+        self._offsets.append(pos + self._HDR.size)
+        self._sizes.append(len(data))
+
+    def get(self, index: int) -> bytes:
+        self._fh.seek(self._offsets[index])
+        return self._fh.read(self._sizes[index])
+
+    def __len__(self) -> int:
+        return len(self._offsets)
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+StorageFn = Callable[[str], object]  # name -> storage backend
+
+
+def memory_storage_fn(_name: str) -> MemoryFeedStorage:
+    return MemoryFeedStorage()
+
+
+def file_storage_fn(root: str) -> StorageFn:
+    def fn(name: str) -> FileFeedStorage:
+        return FileFeedStorage(os.path.join(root, name[:2], name))
+
+    return fn
+
+
+class Feed:
+    """One append-only log, identified by its ed25519 public key."""
+
+    def __init__(
+        self,
+        public_key: str,
+        storage,
+        secret_key: Optional[str] = None,
+    ) -> None:
+        self.public_key = public_key
+        self.secret_key = secret_key
+        self.discovery_id = keymod.discovery_id(public_key)
+        self._storage = storage
+        self._lock = threading.RLock()
+        self._append_listeners: List[Callable[[int, bytes], None]] = []
+
+    @property
+    def writable(self) -> bool:
+        return self.secret_key is not None
+
+    @property
+    def length(self) -> int:
+        with self._lock:
+            return len(self._storage)
+
+    def append(self, data: bytes) -> int:
+        if not self.writable:
+            raise PermissionError(f"feed {self.public_key[:8]} not writable")
+        return self._append_raw(data)
+
+    def _append_raw(self, data: bytes) -> int:
+        """Append without the writability check — replication delivering a
+        remote writer's verified blocks uses this."""
+        with self._lock:
+            self._storage.append(data)
+            index = len(self._storage) - 1
+            listeners = list(self._append_listeners)
+        for cb in listeners:
+            cb(index, data)
+        return index
+
+    def get(self, index: int) -> bytes:
+        with self._lock:
+            return self._storage.get(index)
+
+    def get_batch(self, start: int, end: int) -> List[bytes]:
+        with self._lock:
+            end = min(end, len(self._storage))
+            return [self._storage.get(i) for i in range(start, end)]
+
+    def read_all(self) -> List[bytes]:
+        return self.get_batch(0, self.length)
+
+    def on_append(self, cb: Callable[[int, bytes], None]) -> None:
+        with self._lock:
+            self._append_listeners.append(cb)
+
+    def close(self) -> None:
+        self._storage.close()
+
+
+class FeedStore:
+    """Feeds keyed by public key, with discovery-id lookup.
+
+    Mirrors the reference FeedStore surface (create/append/read/head/
+    stream, reference src/FeedStore.ts:26-142) minus streams — readers
+    subscribe to appends instead."""
+
+    def __init__(self, storage_fn: StorageFn) -> None:
+        self._storage_fn = storage_fn
+        self._feeds: Dict[str, Feed] = {}
+        self._by_discovery: Dict[str, str] = {}
+        self._lock = threading.RLock()
+        self.feed_q: Queue = Queue("feedstore")
+
+    def create(self, pair: keymod.KeyPair) -> Feed:
+        return self._open(pair.public_key, pair.secret_key)
+
+    def open_feed(self, public_key: str) -> Feed:
+        return self._open(public_key, None)
+
+    def _open(self, public_key: str, secret_key: Optional[str]) -> Feed:
+        with self._lock:
+            feed = self._feeds.get(public_key)
+            if feed is None:
+                feed = Feed(
+                    public_key, self._storage_fn(public_key), secret_key
+                )
+                self._feeds[public_key] = feed
+                self._by_discovery[feed.discovery_id] = public_key
+                self.feed_q.push(feed)
+            elif secret_key is not None and feed.secret_key is None:
+                feed.secret_key = secret_key
+            return feed
+
+    def get_feed(self, public_key: str) -> Optional[Feed]:
+        with self._lock:
+            return self._feeds.get(public_key)
+
+    def by_discovery_id(self, discovery_id: str) -> Optional[Feed]:
+        with self._lock:
+            pk = self._by_discovery.get(discovery_id)
+            return self._feeds.get(pk) if pk else None
+
+    def known_discovery_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._by_discovery.keys())
+
+    def append(self, public_key: str, data: bytes) -> int:
+        feed = self._feeds.get(public_key)
+        if feed is None:
+            raise KeyError(public_key)
+        return feed.append(data)
+
+    def read(self, public_key: str, index: int) -> bytes:
+        feed = self._feeds.get(public_key)
+        if feed is None:
+            raise KeyError(public_key)
+        return feed.get(index)
+
+    def head(self, public_key: str) -> bytes:
+        feed = self._feeds[public_key]
+        return feed.get(feed.length - 1)
+
+    def close(self) -> None:
+        with self._lock:
+            for feed in self._feeds.values():
+                feed.close()
+            self._feeds.clear()

@@ -1,0 +1,71 @@
+"""SQLite database: one file (or memory), migrated on open.
+
+Parity: the reference's SqlDatabase + migration (reference
+src/SqlDatabase.ts:11-22, src/migrations/0001_initial_schema.sql — tables
+Clocks/Keys/Cursors/Feeds). Python's stdlib sqlite3 replaces the
+better-sqlite3 native addon; a C++ store can swap in behind this module's
+API without touching callers.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS clocks (
+  repo_id  TEXT NOT NULL,
+  doc_id   TEXT NOT NULL,
+  actor_id TEXT NOT NULL,
+  seq      INTEGER NOT NULL,
+  PRIMARY KEY (repo_id, doc_id, actor_id)
+);
+CREATE TABLE IF NOT EXISTS cursors (
+  repo_id  TEXT NOT NULL,
+  doc_id   TEXT NOT NULL,
+  actor_id TEXT NOT NULL,
+  seq      INTEGER NOT NULL,
+  PRIMARY KEY (repo_id, doc_id, actor_id)
+);
+CREATE INDEX IF NOT EXISTS cursors_by_actor ON cursors (repo_id, actor_id);
+CREATE TABLE IF NOT EXISTS keys (
+  name       TEXT PRIMARY KEY,
+  public_key TEXT NOT NULL,
+  secret_key TEXT
+);
+CREATE TABLE IF NOT EXISTS feeds (
+  public_id    TEXT PRIMARY KEY,
+  discovery_id TEXT NOT NULL,
+  is_writable  INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX IF NOT EXISTS feeds_by_discovery ON feeds (discovery_id);
+"""
+
+
+class SqlDatabase:
+    def __init__(self, path: str = ":memory:") -> None:
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def execute(self, sql: str, params=()) -> sqlite3.Cursor:
+        with self._lock:
+            cur = self._conn.execute(sql, params)
+            self._conn.commit()
+            return cur
+
+    def executemany(self, sql: str, rows) -> None:
+        with self._lock:
+            self._conn.executemany(sql, rows)
+            self._conn.commit()
+
+    def query(self, sql: str, params=()) -> list:
+        with self._lock:
+            return self._conn.execute(sql, params).fetchall()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,265 @@
+"""Clock / Cursor / Key / FeedInfo stores over SqlDatabase.
+
+Parity (SURVEY.md §2.1): ClockStore (monotonic upsert, get/getMultiple/
+update/set, reference src/ClockStore.ts:24-119), CursorStore (INFINITY_SEQ
+clamping, docsWithActor reverse lookup, reference src/CursorStore.ts:19-91),
+KeyStore (named keypairs, reference src/KeyStore.ts:10-39), FeedInfoStore
+(reference src/FeedStore.ts:150-205).
+
+TPU-first addition: ClockStore.union_query / dominated_query lift the
+bulk vector-clock folds onto the device kernels (ops/clock_kernels.py) —
+the 100k-doc query of BASELINE.json config 5 — instead of row-at-a-time
+SQL aggregation.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..crdt import clock as clockmod
+from ..utils import keys as keymod
+from .sql import SqlDatabase
+
+INFINITY_SEQ = clockmod.INFINITY_SEQ
+
+
+def _clamp(seq: float) -> int:
+    if seq == math.inf or seq >= INFINITY_SEQ:
+        return INFINITY_SEQ
+    return int(seq)
+
+
+class ClockStore:
+    def __init__(self, db: SqlDatabase) -> None:
+        self.db = db
+
+    def get(self, repo_id: str, doc_id: str) -> clockmod.Clock:
+        rows = self.db.query(
+            "SELECT actor_id, seq FROM clocks WHERE repo_id=? AND doc_id=?",
+            (repo_id, doc_id),
+        )
+        return {a: s for a, s in rows}
+
+    def get_multiple(
+        self, repo_id: str, doc_ids: Iterable[str]
+    ) -> Dict[str, clockmod.Clock]:
+        ids = list(doc_ids)
+        out: Dict[str, clockmod.Clock] = {d: {} for d in ids}
+        if not ids:
+            return out
+        marks = ",".join("?" for _ in ids)
+        rows = self.db.query(
+            f"SELECT doc_id, actor_id, seq FROM clocks "
+            f"WHERE repo_id=? AND doc_id IN ({marks})",
+            (repo_id, *ids),
+        )
+        for doc_id, actor, seq in rows:
+            out[doc_id][actor] = seq
+        return out
+
+    def update(
+        self, repo_id: str, doc_id: str, clock: clockmod.Clock
+    ) -> clockmod.Clock:
+        """Monotonic merge: only raises seqs (reference's
+        `seq=excluded.seq WHERE excluded.seq > seq` upsert)."""
+        self.db.executemany(
+            "INSERT INTO clocks (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (repo_id, doc_id, actor_id) DO UPDATE "
+            "SET seq=excluded.seq WHERE excluded.seq > seq",
+            [
+                (repo_id, doc_id, a, _clamp(s))
+                for a, s in clock.items()
+            ],
+        )
+        return self.get(repo_id, doc_id)
+
+    def set(
+        self, repo_id: str, doc_id: str, clock: clockmod.Clock
+    ) -> None:
+        """Hard overwrite (reference ClockStore.set)."""
+        self.db.execute(
+            "DELETE FROM clocks WHERE repo_id=? AND doc_id=?",
+            (repo_id, doc_id),
+        )
+        self.db.executemany(
+            "INSERT INTO clocks (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?)",
+            [(repo_id, doc_id, a, _clamp(s)) for a, s in clock.items()],
+        )
+
+    def all_doc_ids(self, repo_id: str) -> List[str]:
+        return [
+            r[0]
+            for r in self.db.query(
+                "SELECT DISTINCT doc_id FROM clocks WHERE repo_id=?",
+                (repo_id,),
+            )
+        ]
+
+    # -- device bulk queries -------------------------------------------
+
+    def _packed(self, repo_id: str, doc_ids: List[str]):
+        clocks = self.get_multiple(repo_id, doc_ids)
+        ordered = [clocks[d] for d in doc_ids]
+        actors = clockmod.actor_axis(ordered)
+        if not actors:
+            return None, []
+        from ..ops import clock_kernels as K
+
+        return K.pack_clocks(clockmod.pack(ordered, actors)), actors
+
+    def union_query(
+        self, repo_id: str, doc_ids: Optional[List[str]] = None
+    ) -> clockmod.Clock:
+        """Union of many docs' clocks in one device reduction."""
+        ids = doc_ids if doc_ids is not None else self.all_doc_ids(repo_id)
+        if not ids:
+            return {}
+        rows, actors = self._packed(repo_id, ids)
+        if rows is None:
+            return {}
+        from ..ops import clock_kernels as K
+
+        merged = K.union_reduce(rows)
+        return clockmod.unpack([[int(x) for x in merged]], actors)[0]
+
+    def dominated_query(
+        self, repo_id: str, query: clockmod.Clock,
+        doc_ids: Optional[List[str]] = None,
+    ) -> List[str]:
+        """All docs whose clock is dominated by `query` (one dispatch)."""
+        ids = doc_ids if doc_ids is not None else self.all_doc_ids(repo_id)
+        if not ids:
+            return []
+        rows, actors = self._packed(repo_id, ids)
+        if rows is None:
+            return list(ids)
+        from ..ops import clock_kernels as K
+        import numpy as np
+
+        q = K.pack_clocks(
+            clockmod.pack([{a: query.get(a, 0) for a in actors}], actors)
+        )[0]
+        ok = np.asarray(K.gte(jnp_broadcast(q, rows), rows))
+        return [d for d, good in zip(ids, ok) if good]
+
+
+def jnp_broadcast(q, rows):
+    import jax.numpy as jnp
+
+    return jnp.broadcast_to(q, rows.shape)
+
+
+class CursorStore:
+    """Which actors (and up to what seq) a repo includes in each doc."""
+
+    def __init__(self, db: SqlDatabase) -> None:
+        self.db = db
+
+    def get(self, repo_id: str, doc_id: str) -> clockmod.Clock:
+        rows = self.db.query(
+            "SELECT actor_id, seq FROM cursors WHERE repo_id=? AND doc_id=?",
+            (repo_id, doc_id),
+        )
+        return {a: s for a, s in rows}
+
+    def entry(self, repo_id: str, doc_id: str, actor_id: str) -> int:
+        rows = self.db.query(
+            "SELECT seq FROM cursors "
+            "WHERE repo_id=? AND doc_id=? AND actor_id=?",
+            (repo_id, doc_id, actor_id),
+        )
+        return rows[0][0] if rows else 0
+
+    def update(
+        self, repo_id: str, doc_id: str, clock: clockmod.Clock
+    ) -> clockmod.Clock:
+        self.db.executemany(
+            "INSERT INTO cursors (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (repo_id, doc_id, actor_id) DO UPDATE "
+            "SET seq=excluded.seq WHERE excluded.seq > seq",
+            [(repo_id, doc_id, a, _clamp(s)) for a, s in clock.items()],
+        )
+        return self.get(repo_id, doc_id)
+
+    def add_actor(
+        self, repo_id: str, doc_id: str, actor_id: str,
+        seq: float = math.inf,
+    ) -> None:
+        self.update(repo_id, doc_id, {actor_id: seq})
+
+    def docs_with_actor(self, repo_id: str, actor_id: str) -> List[str]:
+        return [
+            r[0]
+            for r in self.db.query(
+                "SELECT doc_id FROM cursors WHERE repo_id=? AND actor_id=?",
+                (repo_id, actor_id),
+            )
+        ]
+
+    def actors_for(self, repo_id: str, doc_id: str) -> List[str]:
+        return list(self.get(repo_id, doc_id).keys())
+
+
+class KeyStore:
+    def __init__(self, db: SqlDatabase) -> None:
+        self.db = db
+
+    def get(self, name: str) -> Optional[keymod.KeyPair]:
+        rows = self.db.query(
+            "SELECT public_key, secret_key FROM keys WHERE name=?", (name,)
+        )
+        if not rows:
+            return None
+        return keymod.KeyPair(public_key=rows[0][0], secret_key=rows[0][1])
+
+    def set(self, name: str, pair: keymod.KeyPair) -> keymod.KeyPair:
+        self.db.execute(
+            "INSERT OR REPLACE INTO keys (name, public_key, secret_key) "
+            "VALUES (?,?,?)",
+            (name, pair.public_key, pair.secret_key),
+        )
+        return pair
+
+    def get_or_create(self, name: str) -> keymod.KeyPair:
+        pair = self.get(name)
+        if pair is None:
+            pair = keymod.create()
+            self.set(name, pair)
+        return pair
+
+    def clear(self, name: str) -> None:
+        self.db.execute("DELETE FROM keys WHERE name=?", (name,))
+
+
+class FeedInfoStore:
+    def __init__(self, db: SqlDatabase) -> None:
+        self.db = db
+
+    def save(
+        self, public_id: str, discovery_id: str, is_writable: bool
+    ) -> None:
+        self.db.execute(
+            "INSERT OR REPLACE INTO feeds "
+            "(public_id, discovery_id, is_writable) VALUES (?,?,?)",
+            (public_id, discovery_id, 1 if is_writable else 0),
+        )
+
+    def all_public_ids(self) -> List[str]:
+        return [r[0] for r in self.db.query("SELECT public_id FROM feeds")]
+
+    def by_discovery_id(self, discovery_id: str) -> Optional[str]:
+        rows = self.db.query(
+            "SELECT public_id FROM feeds WHERE discovery_id=?",
+            (discovery_id,),
+        )
+        return rows[0][0] if rows else None
+
+    def is_writable(self, public_id: str) -> bool:
+        rows = self.db.query(
+            "SELECT is_writable FROM feeds WHERE public_id=?", (public_id,)
+        )
+        return bool(rows and rows[0][0])

@@ -10,61 +10,9 @@ import pytest
 
 from hypermerge_tpu.crdt.change import Change
 from hypermerge_tpu.crdt.frontend_state import FrontendDoc
-from hypermerge_tpu.crdt.opset import OpSet
 from hypermerge_tpu.models import Counter, Table, Text
 
-
-class Site:
-    """One collaborator: FrontendDoc + OpSet wired the way the repo runtime
-    wires them (request -> backend -> patch echo)."""
-
-    def __init__(self, actor: str):
-        self.actor = actor
-        self.front = FrontendDoc()
-        self.opset = OpSet()
-        self.seq = 1
-
-    def change(self, fn, message=""):
-        req, preview = self.front.change(fn, self.actor, self.seq, message)
-        if req is None:
-            return None, preview
-        self.seq += 1
-        change, patch = self.opset.apply_local_request(req)
-        self.front.apply_patch(patch)
-        return change, preview
-
-    def receive(self, changes):
-        patch = self.opset.apply_changes(changes)
-        self.front.apply_patch(patch)
-
-    @property
-    def doc(self):
-        return self.front.materialize()
-
-    def assert_consistent(self):
-        assert _plainify(self.opset.materialize()) == _plainify(self.doc)
-
-
-def _plainify(v):
-    if isinstance(v, Text):
-        return ("__text__", str(v))
-    if isinstance(v, Table):
-        return ("__table__", {k: _plainify(v.by_id(k)) for k in v.ids})
-    if isinstance(v, Counter):
-        return ("__counter__", int(v))
-    if isinstance(v, dict):
-        return {k: _plainify(x) for k, x in v.items()}
-    if isinstance(v, list):
-        return [_plainify(x) for x in v]
-    return v
-
-
-def sync(*sites):
-    """Full gossip: every site receives every other site's full history."""
-    for a in sites:
-        for b in sites:
-            if a is not b:
-                a.receive(list(b.opset.history))
+from helpers import Site, plainify as _plainify, sync
 
 
 def test_map_set_and_preview():
@@ -357,3 +305,56 @@ def test_three_way_fuzz_convergence(rng):
     for s in sites:
         s.assert_consistent()
         assert not s.opset._pending
+
+
+def test_concurrent_list_set_vs_delete_resurrects_consistently():
+    """A deleted elem resurrected by a concurrent set must reach the
+    frontend as an *insert* (it already removed the elem)."""
+    a, b = Site("alice"), Site("bob")
+    a.change(lambda d: d.__setitem__("l", ["x", "y", "z"]))
+    b.receive(a.opset.history)
+    a.change(lambda d: d["l"].__delitem__(1))
+    b.change(lambda d: d["l"].__setitem__(1, "Y"))
+    sync(a, b)
+    assert a.doc["l"] == b.doc["l"] == ["x", "Y", "z"]
+    a.assert_consistent()
+    b.assert_consistent()
+
+
+def test_failed_intent_does_not_alias_temp_id():
+    from hypermerge_tpu.crdt.change import Action, ChangeRequest, OpIntent
+
+    s = Site("alice")
+    s.change(lambda d: d.__setitem__("l", []))
+    # handcrafted request: first MAKE targets an out-of-range list index
+    # (fails to resolve); second MAKE succeeds; the SET addressed to the
+    # FAILED temp id must go nowhere — not into the second object
+    list_obj = next(
+        str(o) for o, st in s.opset.objects.items() if st.type == "list"
+    )
+    req = ChangeRequest(
+        "alice",
+        s.seq,
+        0,
+        "",
+        (
+            OpIntent(Action.MAKE_MAP, list_obj, index=99, insert=True,
+                     temp_id="tmp:0"),
+            OpIntent(Action.MAKE_MAP, "_root", key="ok", temp_id="tmp:1"),
+            OpIntent(Action.SET, "tmp:0", key="leak", value="bad"),
+        ),
+    )
+    s.opset.apply_local_request(req)
+    assert s.opset.materialize()["ok"] == {}  # no leak into the wrong obj
+
+
+def test_snapshot_includes_elem_conflicts():
+    a, b = Site("alice"), Site("bob")
+    a.change(lambda d: d.__setitem__("l", ["x"]))
+    b.receive(a.opset.history)
+    a.change(lambda d: d["l"].__setitem__(0, "A"))
+    b.change(lambda d: d["l"].__setitem__(0, "B"))
+    sync(a, b)
+    snap = a.opset.snapshot_patch()
+    ins = [d for d in snap.diffs if d.action == "insert"][0]
+    assert len(ins.conflicts) == 1 and ins.conflicts[0].value == "A"
