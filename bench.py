@@ -1,0 +1,106 @@
+"""Benchmark: CRDT ops applied/sec/chip via batched device materialization.
+
+Workload: BASELINE.json config 4 shape — cold-start re-materialization of
+many chat-shaped docs (text RGA + LWW map churn) from packed op logs, in
+ONE device dispatch. Baseline = the host incremental OpSet replay of the
+same workload (the framework's own Node-CPU-backend equivalent; the
+reference publishes no numbers, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Env overrides: BENCH_DOCS (default 4096), BENCH_OPS (default 1024),
+BENCH_HOST_DOCS (default 8).
+"""
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+
+def main() -> None:
+    n_docs = int(os.environ.get("BENCH_DOCS", "4096"))
+    n_ops = int(os.environ.get("BENCH_OPS", "1024"))
+    host_docs = int(os.environ.get("BENCH_HOST_DOCS", "8"))
+
+    import jax
+
+    from hypermerge_tpu.crdt.opset import OpSet
+    from hypermerge_tpu.ops.crdt_kernels import run_batch
+    from hypermerge_tpu.ops.materialize import DecodedBatch, decode_columnar
+    from hypermerge_tpu.ops.synth import synth_batch, synth_changes
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev}", file=sys.stderr)
+
+    # -- host baseline: incremental OpSet replay ------------------------
+    host_histories = [
+        synth_changes(n_ops, seed=i) for i in range(host_docs)
+    ]
+    t0 = time.perf_counter()
+    for history in host_histories:
+        opset = OpSet()
+        opset.apply_changes(history)
+    host_dt = time.perf_counter() - t0
+    host_rate = host_docs * n_ops / host_dt
+    print(
+        f"# host baseline: {host_docs} docs x {n_ops} ops in "
+        f"{host_dt:.2f}s -> {host_rate:,.0f} ops/s",
+        file=sys.stderr,
+    )
+
+    # -- device: one batched dispatch ----------------------------------
+    batch = synth_batch(n_docs, n_ops)
+    total_ops = int(batch.n_ops.sum())
+    t0 = time.perf_counter()
+    out = run_batch(batch)
+    jax.block_until_ready(out)
+    compile_dt = time.perf_counter() - t0
+    print(f"# first dispatch (incl compile): {compile_dt:.1f}s",
+          file=sys.stderr)
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = run_batch(batch)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    device_dt = min(times)
+    device_rate = total_ops / device_dt
+
+    # include the columnar decode (numpy summary) in the reported
+    # wall-clock for the re-materialize figure
+    t0 = time.perf_counter()
+    dec = DecodedBatch(batch, out)
+    cols = decode_columnar(dec)
+    decode_dt = time.perf_counter() - t0
+    e2e_rate = total_ops / (device_dt + decode_dt)
+
+    print(
+        f"# device: {n_docs} docs x {n_ops} ops = {total_ops} ops in "
+        f"{device_dt*1e3:.0f}ms kernel + {decode_dt*1e3:.0f}ms decode "
+        f"-> {device_rate:,.0f} ops/s kernel, {e2e_rate:,.0f} ops/s e2e",
+        file=sys.stderr,
+    )
+    print(
+        f"# live elems: {int(cols['n_live_elems'].sum())}, "
+        f"map entries: {int(cols['n_map_entries'].sum())}",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "crdt_ops_materialized_per_sec_per_chip",
+                "value": round(e2e_rate),
+                "unit": "ops/s",
+                "vs_baseline": round(e2e_rate / host_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Multi-chip scale-out: mesh construction + sharded batched programs."""

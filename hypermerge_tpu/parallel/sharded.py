@@ -1,0 +1,163 @@
+"""Sharded batched programs: the multi-chip execution path.
+
+The reference processes documents one at a time on one Node thread
+(SURVEY.md §2.3); here the same workloads run as SPMD programs over a
+(dp, sp) Mesh:
+
+- `sharded_materialize`: the full batched CRDT replay (ops/crdt_kernels)
+  with every [D, N] column sharded on dp. Per-doc compute has no cross-doc
+  data flow, so XLA compiles this with zero collectives — linear scaling
+  over chips.
+- `sharded_clock_union` / `sharded_dominated`: [D, A] clock matrices
+  sharded (dp, sp); the doc-axis reduction crosses shards, so XLA inserts
+  max-reduce collectives over ICI (the ClockStore bulk queries at 100k-doc
+  scale, BASELINE.json config 5).
+- `step`: one full "merge step" combining materialize + clock union —
+  what dryrun_multichip exercises end-to-end.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.columnar import ColumnarBatch
+from ..ops.crdt_kernels import MaterializeOut, _doc_kernel
+from .mesh import doc_actor_sharding, doc_sharding, pad_to_multiple
+
+_COL_ORDER = (
+    "action", "actor", "ctr", "seq", "obj", "key", "ref", "insert", "value",
+)
+
+
+def _batch_kernel(A: int, K: int):
+    def fn(action, actor, ctr, seq, obj, key, ref, insert, value,
+           psrc, ptgt):
+        return jax.vmap(lambda *xs: _doc_kernel(*xs, A=A, K=K))(
+            action, actor, ctr, seq, obj, key, ref, insert, value,
+            psrc, ptgt,
+        )
+
+    return fn
+
+
+def shard_batch(batch: ColumnarBatch, mesh: Mesh):
+    """Pad the doc axis to the dp size and device_put with dp sharding."""
+    import numpy as np
+
+    dp = mesh.shape["dp"]
+    D = batch.n_docs
+    D_pad = pad_to_multiple(max(D, dp), dp)
+    sh = doc_sharding(mesh)
+
+    def put(arr, pad_value):
+        if D_pad != D:
+            pad = np.full((D_pad - D, *arr.shape[1:]), pad_value, arr.dtype)
+            arr = np.concatenate([arr, pad], axis=0)
+        return jax.device_put(arr, sh)
+
+    from ..ops.columnar import PAD
+
+    cols = {}
+    for name in _COL_ORDER:
+        pad_value = PAD if name == "action" else (-1 if name in ("obj", "key") else (-3 if name == "ref" else 0))
+        cols[name] = put(batch.cols[name], pad_value)
+    psrc = put(batch.psrc, -1)
+    ptgt = put(batch.ptgt, -1)
+    return cols, psrc, ptgt, D_pad
+
+
+def sharded_materialize(
+    batch: ColumnarBatch, mesh: Mesh
+) -> MaterializeOut:
+    """Batched replay sharded over dp; returns device-sharded outputs."""
+    A = max(1, len(batch.actors))
+    K = len(batch.keys)
+    cols, psrc, ptgt, _ = shard_batch(batch, mesh)
+    fn = jax.jit(
+        _batch_kernel(A, K),
+        in_shardings=(doc_sharding(mesh),) * 9
+        + (doc_sharding(mesh), doc_sharding(mesh)),
+        out_shardings=MaterializeOut(
+            dead=doc_sharding(mesh),
+            visible=doc_sharding(mesh),
+            map_winner=doc_sharding(mesh),
+            elem_winner=doc_sharding(mesh),
+            elem_live=doc_sharding(mesh),
+            rank=doc_sharding(mesh),
+            inc_total=doc_sharding(mesh),
+            clock=doc_sharding(mesh),
+        ),
+    )
+    with mesh:
+        return fn(*[cols[n] for n in _COL_ORDER], psrc, ptgt)
+
+
+@partial(jax.jit, static_argnames=())
+def _union_reduce(clocks):
+    return jnp.max(clocks, axis=0)
+
+
+def _pad_axes(arr, mesh: Mesh):
+    """Pad [D, A] to (dp, sp) multiples with zeros (neutral for max and
+    for <= domination checks)."""
+    import numpy as np
+
+    arr = np.asarray(arr)
+    D, A = arr.shape
+    Dp = pad_to_multiple(max(D, mesh.shape["dp"]), mesh.shape["dp"])
+    Ap = pad_to_multiple(max(A, mesh.shape["sp"]), mesh.shape["sp"])
+    if (Dp, Ap) != (D, A):
+        out = np.zeros((Dp, Ap), arr.dtype)
+        out[:D, :A] = arr
+        arr = out
+    return arr, D, A
+
+
+def sharded_clock_union(clocks, mesh: Mesh):
+    """[D, A] -> [A] union across a (dp, sp)-sharded clock matrix; the
+    dp-axis max-reduce becomes an ICI collective."""
+    arr, _D, A = _pad_axes(clocks, mesh)
+    sh = doc_actor_sharding(mesh)
+    arr = jax.device_put(arr, sh)
+    fn = jax.jit(
+        lambda c: jnp.max(c, axis=0),
+        in_shardings=sh,
+        out_shardings=NamedSharding(mesh, P("sp")),
+    )
+    with mesh:
+        return fn(arr)[:A]
+
+
+def sharded_dominated(clocks, query, mesh: Mesh):
+    """[D, A], [A] -> [D] bool: which docs' clocks the query dominates.
+    The actor-axis `all` reduction crosses sp shards."""
+    import numpy as np
+
+    arr, D, A = _pad_axes(clocks, mesh)
+    q = np.zeros((arr.shape[1],), arr.dtype)
+    q[:A] = np.asarray(query)
+    csh = doc_actor_sharding(mesh)
+    qsh = NamedSharding(mesh, P("sp"))
+    arr = jax.device_put(arr, csh)
+    q = jax.device_put(q, qsh)
+    fn = jax.jit(
+        lambda c, qq: jnp.all(c <= qq[None, :], axis=-1),
+        in_shardings=(csh, qsh),
+        out_shardings=NamedSharding(mesh, P("dp")),
+    )
+    with mesh:
+        return fn(arr, q)[:D]
+
+
+def step(batch: ColumnarBatch, mesh: Mesh):
+    """One full merge step: materialize everything + union every clock.
+    This is the framework's 'training step' analogue — the complete
+    device-side work of a bulk sync cycle."""
+    out = sharded_materialize(batch, mesh)
+    union = sharded_clock_union(out.clock, mesh)
+    return out, union
