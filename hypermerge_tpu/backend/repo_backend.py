@@ -137,11 +137,12 @@ class RepoBackend:
         """Adopt the target clock's actors into this doc's cursor; actual
         op merge falls out of sync_changes (reference src/RepoBackend.ts:
         213-217)."""
-        self.open(doc_id)
+        doc = self.open(doc_id)
         self.cursors.update(self.id, doc_id, clock)
         for actor_id in clock:
             actor = self._get_or_create_actor(actor_id)
             self._sync_changes(actor)
+        self._gossip_cursor(doc)
 
     def close_doc(self, doc_id: str) -> None:
         with self._lock:
@@ -189,6 +190,14 @@ class RepoBackend:
             # minimumClock render gate, src/DocBackend.ts:90-113)
             doc.update_minimum_clock({root: 1})
         doc.init(changes, writable)
+        # Feed announcements above can deliver blocks re-entrantly while
+        # doc.opset is still None (so _sync_changes skipped them); the
+        # cursor may also have grown via CursorMessages. Re-sync every
+        # cursor actor now that the doc can apply changes.
+        for actor_id in self.cursors.get(self.id, doc.id):
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                self._sync_changes(actor)
 
     def load_documents_bulk(self, doc_ids: List[str]) -> None:
         """Cold-start many docs in ONE device dispatch: gather each doc's
@@ -266,6 +275,8 @@ class RepoBackend:
             self.feed_info.save(
                 feed.public_key, feed.discovery_id, feed.writable
             )
+            if self.network is not None:
+                self.network.announce_feed(feed)
         return actor
 
     def _sync_changes(self, actor: Actor) -> None:
@@ -389,6 +400,40 @@ class RepoBackend:
     def deliver_doc_message(self, doc_id: str, contents: Any) -> None:
         """Inbound ephemeral message from a peer."""
         self.to_frontend.push(msgs.doc_message_fwd_msg(doc_id, contents))
+
+    def on_cursor_message(
+        self,
+        peer,
+        doc_id: str,
+        cursors: clockmod.Clock,
+        clocks: clockmod.Clock,
+    ) -> None:
+        """Peer told us which actors (and how far) a doc includes: expand
+        our cursor, gate rendering on their clock, open missing feeds
+        (reference src/RepoBackend.ts:394-427). The peer's clock is
+        recorded under the SENDER's id — our own clock row only ever
+        reflects changes we actually applied (else we'd advertise state we
+        can't supply to third parties)."""
+        self.cursors.update(self.id, doc_id, cursors)
+        self.clocks.update(peer.id, doc_id, clocks)
+        doc = self.docs.get(doc_id)
+        if doc is not None:
+            doc.update_minimum_clock(clocks)
+        for actor_id in cursors:
+            actor = self._get_or_create_actor(actor_id)
+            self._sync_changes(actor)
+
+    def on_discovery(self, public_id: str, peer) -> None:
+        """A feed shared with `peer` was discovered: send our cursor +
+        clock for every doc that includes that actor (reference
+        src/RepoBackend.ts:374-392)."""
+        for doc_id in self.cursors.docs_with_actor(self.id, public_id):
+            self.network.send_cursor_to(
+                peer,
+                doc_id,
+                self.cursors.get(self.id, doc_id),
+                self.clocks.get(self.id, doc_id),
+            )
 
     def _gossip_cursor(self, doc: DocBackend) -> None:
         if self.network is not None:

@@ -1,0 +1,2 @@
+"""Network layer: peer connections, replication, pluggable discovery
+(SURVEY.md §1.5)."""

@@ -1,0 +1,86 @@
+"""PeerConnection — one transport with named multiplexed channels.
+
+Parity: reference src/PeerConnection.ts:14-86 + src/MessageBus.ts — one
+socket carrying noise-encrypted multiplexed substreams with a
+`NetworkBus` channel always open, and channels opened by the remote side
+first buffering until locally opened (the reference's pending-channel
+hack, src/PeerConnection.ts:64-73).
+
+Encryption: the Duplex transport is a seam — the in-memory pair needs
+none; the TCP adapter (net/tcp.py) carries framing and is where a
+noise-style handshake slots in (native C++ codec planned; interface kept
+byte-compatible).
+"""
+
+from __future__ import annotations
+
+import uuid
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from ..utils.queue import Queue
+from .duplex import Duplex
+
+NETWORK_BUS = "NetworkBus"
+
+
+class Channel:
+    def __init__(self, conn: "PeerConnection", name: str) -> None:
+        self._conn = conn
+        self.name = name
+        self.receive_q: Queue = Queue(f"ch:{name}")
+
+    def send(self, msg: Any) -> None:
+        self._conn._send_on(self.name, msg)
+
+    def subscribe(self, cb: Callable[[Any], None]) -> None:
+        self.receive_q.subscribe(cb)
+
+
+class PeerConnection:
+    def __init__(self, duplex: Duplex, is_client: bool) -> None:
+        self.id = uuid.uuid4().hex
+        self.is_client = is_client
+        self._duplex = duplex
+        self._channels: Dict[str, Channel] = {}
+        self.is_open = True
+        self._close_listeners = []
+        self.network_bus = self.open_channel(NETWORK_BUS)
+        duplex.on_message(self._on_raw)
+        duplex.on_close(self._on_transport_close)
+
+    def open_channel(self, name: str) -> Channel:
+        ch = self._channels.get(name)
+        if ch is None:
+            ch = Channel(self, name)
+            self._channels[name] = ch
+        return ch
+
+    def _send_on(self, name: str, msg: Any) -> None:
+        if self.is_open:
+            self._duplex.send({"ch": name, "m": msg})
+
+    def _on_raw(self, raw: Any) -> None:
+        try:
+            name, msg = raw["ch"], raw["m"]
+        except (TypeError, KeyError):
+            return  # malformed frame: drop
+        # channels opened by the remote first buffer in their queue
+        self.open_channel(name).receive_q.push(msg)
+
+    def on_close(self, cb: Callable[[], None]) -> None:
+        self._close_listeners.append(cb)
+
+    def _on_transport_close(self) -> None:
+        if not self.is_open:
+            return
+        self.is_open = False
+        for cb in list(self._close_listeners):
+            cb()
+
+    def close(self) -> None:
+        if self.is_open:
+            self.is_open = False
+            self._duplex.close()
+            for cb in list(self._close_listeners):
+                cb()

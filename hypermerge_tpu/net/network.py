@@ -1,0 +1,207 @@
+"""Network — swarm lifecycle, peer handshake, message routing.
+
+Parity: reference src/Network.ts:7-112 (join/leave sets, connection
+handshake with Info exchange + self-connect rejection) +
+src/MessageRouter.ts (typed channels per peer) wired into the repo hub:
+cursor/clock gossip and ephemeral doc messages ride the "Msgs" channel
+(reference channel 'HypermergeMessages', src/RepoBackend.ts:113), feed
+sync rides "Replication" (net/replication.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Set
+
+from .. import msgs
+from ..crdt import clock as clockmod
+from ..utils.debug import log
+from .connection import PeerConnection
+from .duplex import Duplex
+from .peer import NetworkPeer
+from .replication import ReplicationManager
+from .swarm import ConnectionDetails, Swarm
+
+MSGS_CHANNEL = "Msgs"
+
+
+class Network:
+    def __init__(self, backend) -> None:
+        self.backend = backend
+        self.self_id: str = backend.id
+        self.swarm: Optional[Swarm] = None
+        self.joined: Set[str] = set()
+        self.pending_joins: Set[str] = set()
+        self.peers: Dict[str, NetworkPeer] = {}
+        self.closed_connection_count = 0
+        self._lock = threading.RLock()
+        self.replication = ReplicationManager(
+            backend.feeds, self._on_feed_discovery
+        )
+
+    # ------------------------------------------------------------------
+    # swarm lifecycle
+
+    def set_swarm(self, swarm: Swarm) -> None:
+        if self.swarm is not None:
+            raise RuntimeError("swarm already set")
+        self.swarm = swarm
+        swarm.on_connection(self._on_connection)
+        for did in self.backend.feeds.known_discovery_ids():
+            self.join(did)
+        for did in list(self.pending_joins):
+            self.join(did)
+
+    def join(self, discovery_id: str) -> None:
+        if self.swarm is None:
+            self.pending_joins.add(discovery_id)
+            return
+        with self._lock:
+            if discovery_id in self.joined:
+                return
+            self.joined.add(discovery_id)
+        self.swarm.join(discovery_id)
+
+    def leave(self, discovery_id: str) -> None:
+        with self._lock:
+            self.joined.discard(discovery_id)
+        if self.swarm is not None:
+            self.swarm.leave(discovery_id)
+
+    # ------------------------------------------------------------------
+    # connections
+
+    def _on_connection(
+        self, duplex: Duplex, details: ConnectionDetails
+    ) -> None:
+        conn = PeerConnection(duplex, is_client=details.client)
+        state = {"done": False}
+
+        def on_info(msg: Any) -> None:
+            if state["done"] or not isinstance(msg, dict):
+                return
+            if msg.get("type") != "Info":
+                return
+            state["done"] = True
+            # hand the bus off to the NetworkPeer (single-subscriber
+            # queue); anything arriving in between buffers
+            conn.network_bus.receive_q.unsubscribe()
+            peer_id = msg.get("peerId")
+            if peer_id == self.self_id:
+                log("network", "rejecting self-connection")
+                details.reconnect(False)
+                conn.close()
+                return
+            self._add_peer_connection(peer_id, conn)
+
+        conn.network_bus.subscribe(on_info)
+        conn.network_bus.send(msgs.info_msg(self.self_id))
+        conn.on_close(self._count_close)
+
+    def _count_close(self) -> None:
+        self.closed_connection_count += 1
+
+    def _add_peer_connection(
+        self, peer_id: str, conn: PeerConnection
+    ) -> None:
+        with self._lock:
+            peer = self.peers.get(peer_id)
+            if peer is None:
+                peer = NetworkPeer(
+                    self.self_id,
+                    peer_id,
+                    self._on_peer_active,
+                    self._on_peer_inactive,
+                )
+                self.peers[peer_id] = peer
+        peer.add_connection(conn)
+
+    def _on_peer_active(self, peer: NetworkPeer) -> None:
+        """Fires for EVERY connection that becomes active (including
+        replacements after churn): wire channels on the new connection."""
+        log("network", f"peer active {peer.id[:6]}")
+        ch = peer.connection.open_channel(MSGS_CHANNEL)
+        ch.subscribe(lambda msg: self._on_peer_msg(peer, msg))
+        self.replication.on_peer(peer)
+
+    def _on_peer_inactive(self, peer: NetworkPeer) -> None:
+        """Active connection lost without replacement: reset replication
+        associations so a reconnect renegotiates from scratch."""
+        log("network", f"peer inactive {peer.id[:6]}")
+        self.replication.on_peer_closed(peer)
+
+    # ------------------------------------------------------------------
+    # message routing
+
+    def _on_peer_msg(self, peer: NetworkPeer, msg: Any) -> None:
+        if not isinstance(msg, dict):
+            return
+        try:
+            t = msg.get("type")
+            if t == "CursorMessage":
+                self.backend.on_cursor_message(
+                    peer,
+                    msg["id"],
+                    clockmod.strs_to_clock(msg["cursors"]),
+                    clockmod.strs_to_clock(msg["clocks"]),
+                )
+            elif t == "DocumentMessage":
+                self.backend.deliver_doc_message(msg["id"], msg["contents"])
+        except (KeyError, TypeError, ValueError) as e:
+            # malformed frames from buggy/hostile peers must not kill the
+            # transport's reader
+            log("network", f"malformed peer msg from {peer.id[:6]}: {e}")
+
+    def _on_feed_discovery(self, public_id: str, peer: NetworkPeer) -> None:
+        self.backend.on_discovery(public_id, peer)
+
+    # ------------------------------------------------------------------
+    # outbound (called by RepoBackend)
+
+    def announce_feed(self, feed) -> None:
+        self.join(feed.discovery_id)
+        self.replication.announce(feed)
+
+    def _peers_for_doc(self, doc_id: str) -> Set[NetworkPeer]:
+        from ..utils import keys as keymod
+
+        peers: Set[NetworkPeer] = set()
+        for actor_id in self.backend.cursors.actors_for(
+            self.backend.id, doc_id
+        ):
+            did = keymod.discovery_id(actor_id)
+            peers.update(self.replication.peers_with_feed(did))
+        return peers
+
+    def send_cursor_to(self, peer: NetworkPeer, doc_id: str,
+                       cursor: clockmod.Clock, clock: clockmod.Clock) -> None:
+        if peer.is_connected:
+            peer.connection.open_channel(MSGS_CHANNEL).send(
+                msgs.cursor_message(
+                    doc_id,
+                    clockmod.clock_to_strs(cursor),
+                    clockmod.clock_to_strs(clock),
+                )
+            )
+
+    def gossip_cursor(
+        self, doc_id: str, cursor: clockmod.Clock, clock: clockmod.Clock
+    ) -> None:
+        for peer in self._peers_for_doc(doc_id):
+            self.send_cursor_to(peer, doc_id, cursor, clock)
+
+    def broadcast_doc_message(self, doc_id: str, contents: Any) -> None:
+        for peer in self._peers_for_doc(doc_id):
+            if peer.is_connected:
+                peer.connection.open_channel(MSGS_CHANNEL).send(
+                    msgs.document_message(doc_id, contents)
+                )
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        for peer in list(self.peers.values()):
+            peer.close()
+        self.peers.clear()
+        if self.swarm is not None:
+            self.swarm.destroy()

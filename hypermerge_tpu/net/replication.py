@@ -1,0 +1,197 @@
+"""ReplicationManager — feed sync between peers.
+
+Parity: reference src/ReplicationManager.ts:25-137 — peers exchange the
+discovery ids of every feed they know (never the public keys: a peer only
+replicates a feed it already knows the key for), intersect, replicate
+shared feeds, announce newly-created feeds, and surface Discovery events
+so the repo can send cursor gossip (reference :56-112).
+
+Wire protocol on the "Replication" channel (replaces hypercore-protocol):
+  DiscoveryIds {ids}            full/delta announcement
+  FeedLength   {id, length}     my block count for a shared feed
+  Request      {id, from}       send me blocks starting at `from`
+  Blocks       {id, from, blocks(b64)}  in-order block payload
+
+Live tail: local appends push Blocks to every peer replicating the feed.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from ..storage.feed import Feed, FeedStore
+from ..utils.debug import log
+from ..utils.mapset import MapSet
+from .peer import NetworkPeer
+
+CHANNEL = "Replication"
+
+
+class ReplicationManager:
+    def __init__(
+        self,
+        feeds: FeedStore,
+        on_discovery: Callable[[str, NetworkPeer], None],
+    ) -> None:
+        self.feeds = feeds
+        self._on_discovery = on_discovery
+        self._lock = threading.RLock()
+        self._peers: Set[NetworkPeer] = set()
+        # discovery_id -> peers replicating it with us
+        self._replicating: MapSet = MapSet()
+        self._tailed: Set[str] = set()  # feeds we attached appenders to
+
+    # ------------------------------------------------------------------
+
+    def on_peer(self, peer: NetworkPeer) -> None:
+        with self._lock:
+            self._peers.add(peer)
+        ch = peer.connection.open_channel(CHANNEL)
+        ch.subscribe(lambda msg: self._on_message(peer, msg))
+        ch.send(
+            {"type": "DiscoveryIds", "ids": self.feeds.known_discovery_ids()}
+        )
+
+    def on_peer_closed(self, peer: NetworkPeer) -> None:
+        with self._lock:
+            self._peers.discard(peer)
+            for did in self._replicating.keys_with(peer):
+                self._replicating.remove(did, peer)
+
+    def announce(self, feed: Feed) -> None:
+        """A newly created/opened feed: tell every connected peer
+        (reference's late-feed announcement, ReplicationManager.ts:91-96)."""
+        self._tail(feed)
+        with self._lock:
+            peers = list(self._peers)
+        for peer in peers:
+            if peer.is_connected:
+                peer.connection.open_channel(CHANNEL).send(
+                    {"type": "DiscoveryIds", "ids": [feed.discovery_id]}
+                )
+
+    def peers_with_feed(self, discovery_id: str) -> List[NetworkPeer]:
+        with self._lock:
+            return [
+                p for p in self._replicating.get(discovery_id)
+                if p.is_connected
+            ]
+
+    # ------------------------------------------------------------------
+
+    def _on_message(self, peer: NetworkPeer, msg: Dict) -> None:
+        if not isinstance(msg, dict):
+            return
+        try:
+            t = msg.get("type")
+            if t == "DiscoveryIds":
+                self._on_discovery_ids(peer, list(msg["ids"]))
+            elif t == "FeedLength":
+                self._on_feed_length(peer, msg["id"], int(msg["length"]))
+            elif t == "Request":
+                self._on_request(peer, msg["id"], int(msg["from"]))
+            elif t == "Blocks":
+                self._on_blocks(
+                    peer, msg["id"], int(msg["from"]), list(msg["blocks"])
+                )
+        except (KeyError, TypeError, ValueError) as e:
+            log("replication", f"malformed msg from {peer.id[:6]}: {e}")
+
+    def _start_replicating(
+        self, peer: NetworkPeer, feed: Feed, announce_length: bool
+    ) -> bool:
+        """First association of (feed, peer): tail the feed, optionally
+        announce our length, and fire the Discovery event. Returns True
+        if this was the first association."""
+        newly = self._replicating.add(feed.discovery_id, peer)
+        if newly:
+            self._tail(feed)
+            if announce_length:
+                self._send(peer, {
+                    "type": "FeedLength",
+                    "id": feed.discovery_id,
+                    "length": feed.length,
+                })
+            self._on_discovery(feed.public_key, peer)
+        return newly
+
+    def _on_discovery_ids(self, peer: NetworkPeer, ids: List[str]) -> None:
+        for did in ids:
+            feed = self.feeds.by_discovery_id(did)
+            if feed is None:
+                continue  # we don't know this feed's key — can't replicate
+            self._start_replicating(peer, feed, announce_length=True)
+
+    def _on_feed_length(
+        self, peer: NetworkPeer, did: str, their_len: int
+    ) -> None:
+        feed = self.feeds.by_discovery_id(did)
+        if feed is None:
+            return
+        self._start_replicating(peer, feed, announce_length=False)
+        if feed.length < their_len:
+            self._send(peer, {
+                "type": "Request", "id": did, "from": feed.length,
+            })
+        elif feed.length > their_len:
+            self._send(peer, {
+                "type": "FeedLength", "id": did, "length": feed.length,
+            })
+
+    def _on_request(self, peer: NetworkPeer, did: str, start: int) -> None:
+        feed = self.feeds.by_discovery_id(did)
+        if feed is None:
+            return
+        blocks = feed.get_batch(start, feed.length)
+        if blocks:
+            self._send(peer, {
+                "type": "Blocks",
+                "id": did,
+                "from": start,
+                "blocks": [
+                    base64.b64encode(b).decode("ascii") for b in blocks
+                ],
+            })
+
+    def _on_blocks(
+        self, peer: NetworkPeer, did: str, start: int, blocks: List[str]
+    ) -> None:
+        feed = self.feeds.by_discovery_id(did)
+        if feed is None:
+            return
+        if start > feed.length:
+            # gap: re-request from our actual head
+            self._send(peer, {
+                "type": "Request", "id": did, "from": feed.length,
+            })
+            return
+        for i, b64 in enumerate(blocks):
+            index = start + i
+            if index < feed.length:
+                continue  # duplicate
+            feed._append_raw(base64.b64decode(b64))
+
+    def _tail(self, feed: Feed) -> None:
+        with self._lock:
+            if feed.public_key in self._tailed:
+                return
+            self._tailed.add(feed.public_key)
+        did = feed.discovery_id
+
+        def on_append(index: int, data: bytes) -> None:
+            payload = {
+                "type": "Blocks",
+                "id": did,
+                "from": index,
+                "blocks": [base64.b64encode(data).decode("ascii")],
+            }
+            for peer in self.peers_with_feed(did):
+                self._send(peer, payload)
+
+        feed.on_append(on_append)
+
+    def _send(self, peer: NetworkPeer, msg: Dict) -> None:
+        if peer.is_connected:
+            peer.connection.open_channel(CHANNEL).send(msg)

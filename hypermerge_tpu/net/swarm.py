@@ -1,0 +1,114 @@
+"""Swarm interface + in-process loopback implementation.
+
+Parity: the reference never hard-depends on a discovery mechanism — any
+object with join/leave/on-connection/destroy works (reference
+src/SwarmInterface.ts:6-58, README.md:26-34). `LoopbackSwarm` is the
+in-process implementation (the testSwarm/testDuplexPair role from the
+reference's tests, tests/misc.ts:34-36, :70-112); net/tcp.py provides a
+socket-based swarm for real inter-process networking.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from .duplex import Duplex, duplex_pair
+
+
+class ConnectionDetails:
+    def __init__(self, client: bool, peer_info=None) -> None:
+        self.client = client
+        self.peer = peer_info
+        self._reconnect_allowed = True
+        self.banned = False
+
+    def reconnect(self, allowed: bool) -> None:
+        self._reconnect_allowed = allowed
+
+    def ban(self) -> None:
+        self.banned = True
+
+
+class Swarm:
+    """Structural base: join/leave by discovery id; emits connections."""
+
+    def join(self, discovery_id: str) -> None:
+        raise NotImplementedError
+
+    def leave(self, discovery_id: str) -> None:
+        raise NotImplementedError
+
+    def on_connection(
+        self, cb: Callable[[Duplex, ConnectionDetails], None]
+    ) -> None:
+        raise NotImplementedError
+
+    def destroy(self) -> None:
+        raise NotImplementedError
+
+
+class LoopbackHub:
+    """Shared rendezvous for LoopbackSwarms in one process: when two
+    swarms join the same discovery id, a duplex pair connects them."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._members: Dict[str, List["LoopbackSwarm"]] = {}
+
+    def join(self, swarm: "LoopbackSwarm", discovery_id: str) -> None:
+        with self._lock:
+            members = self._members.setdefault(discovery_id, [])
+            others = [s for s in members if s is not swarm]
+            if swarm not in members:
+                members.append(swarm)
+        for other in others:
+            if (other, swarm) not in _connected_pairs(swarm, other):
+                _connect(swarm, other)
+
+    def leave(self, swarm: "LoopbackSwarm", discovery_id: str) -> None:
+        with self._lock:
+            members = self._members.get(discovery_id, [])
+            if swarm in members:
+                members.remove(swarm)
+
+
+def _connected_pairs(a: "LoopbackSwarm", b: "LoopbackSwarm") -> Set:
+    return a.connected & {(a, b), (b, a)}
+
+
+def _connect(client: "LoopbackSwarm", server: "LoopbackSwarm") -> None:
+    if (client, server) in client.connected:
+        return
+    client.connected.add((client, server))
+    server.connected.add((client, server))
+    d1, d2 = duplex_pair()
+    client.emit(d1, ConnectionDetails(client=True))
+    server.emit(d2, ConnectionDetails(client=False))
+
+
+class LoopbackSwarm(Swarm):
+    def __init__(self, hub: LoopbackHub) -> None:
+        self.hub = hub
+        self.joined: Set[str] = set()
+        self.connected: Set = set()
+        self._cb: Optional[Callable] = None
+
+    def join(self, discovery_id: str) -> None:
+        self.joined.add(discovery_id)
+        self.hub.join(self, discovery_id)
+
+    def leave(self, discovery_id: str) -> None:
+        self.joined.discard(discovery_id)
+        self.hub.leave(self, discovery_id)
+
+    def on_connection(self, cb) -> None:
+        self._cb = cb
+
+    def emit(self, duplex: Duplex, details: ConnectionDetails) -> None:
+        if self._cb is not None:
+            self._cb(duplex, details)
+
+    def destroy(self) -> None:
+        for d in list(self.joined):
+            self.leave(d)

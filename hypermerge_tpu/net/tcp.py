@@ -1,0 +1,172 @@
+"""TCP transport: socket-backed Duplex + a dial/accept swarm.
+
+Carries the same object-message Duplex interface as the in-memory pair
+(net/duplex.py) over real sockets with length-prefixed JSON frames, so the
+whole connection/peer/replication stack is transport-agnostic — exactly
+the reference's layering (sockets at the bottom, reference
+src/PeerConnection.ts; discovery injected from outside,
+src/SwarmInterface.ts).
+
+`TcpSwarm` accepts inbound connections and dials explicit addresses
+(`connect`). DHT-style peer discovery stays pluggable/external like the
+reference's hyperswarm; `connect` is the bootstrap primitive a discovery
+implementation would call.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+from ..utils.debug import log
+from .swarm import ConnectionDetails, Swarm
+
+_HDR = struct.Struct("<I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+class TcpDuplex:
+    """Object-message duplex over one socket (JSON frames). Inbound
+    buffering rides utils.queue.Queue (same never-concurrent /
+    never-reordered guarantees as the rest of the stack)."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        from ..utils.queue import Queue
+
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._inbox: "Queue" = Queue("tcp:inbox")
+        self._on_close: Optional[Callable[[], None]] = None
+        self._lock = threading.RLock()
+        self.closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def on_message(self, cb: Callable[[Any], None]) -> None:
+        self._inbox.subscribe(cb)
+
+    def on_close(self, cb: Callable[[], None]) -> None:
+        fire_now = False
+        with self._lock:
+            if self.closed:
+                fire_now = True  # closed before anyone registered
+            else:
+                self._on_close = cb
+        if fire_now:
+            cb()
+
+    def send(self, msg: Any) -> None:
+        if self.closed:
+            return
+        data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+        try:
+            with self._wlock:
+                self._sock.sendall(_HDR.pack(len(data)) + data)
+        except OSError:
+            self.close()
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        while not self.closed:
+            hdr = self._read_exact(_HDR.size)
+            if hdr is None:
+                break
+            (size,) = _HDR.unpack(hdr)
+            if size > _MAX_FRAME:
+                log("net:tcp", f"oversized frame {size}, closing")
+                break
+            payload = self._read_exact(size)
+            if payload is None:
+                break
+            try:
+                msg = json.loads(payload.decode("utf-8"))
+            except ValueError:
+                continue  # corrupt frame: skip
+            try:
+                self._inbox.push(msg)
+            except Exception as e:  # subscriber bug must not kill reader
+                log("net:tcp", f"inbound handler error: {e}")
+                break
+        self.close()
+
+    def close(self) -> None:
+        with self._lock:
+            if self.closed:
+                return
+            self.closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        if self._on_close is not None:
+            self._on_close()
+
+
+class TcpSwarm(Swarm):
+    """Accepts inbound connections; dials peers via `connect(addr)`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(16)
+        self.address: Tuple[str, int] = self._server.getsockname()
+        self._cb: Optional[Callable] = None
+        self._duplexes: List[TcpDuplex] = []
+        self._destroyed = False
+        self._accepter = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accepter.start()
+
+    def _accept_loop(self) -> None:
+        while not self._destroyed:
+            try:
+                sock, _addr = self._server.accept()
+            except OSError:
+                break
+            duplex = TcpDuplex(sock)
+            self._duplexes.append(duplex)
+            if self._cb is not None:
+                self._cb(duplex, ConnectionDetails(client=False))
+
+    def connect(self, address: Tuple[str, int]) -> None:
+        sock = socket.create_connection(address, timeout=10)
+        duplex = TcpDuplex(sock)
+        self._duplexes.append(duplex)
+        if self._cb is not None:
+            self._cb(duplex, ConnectionDetails(client=True))
+
+    # discovery is external (reference: hyperswarm); topics are no-ops here
+    def join(self, discovery_id: str) -> None:
+        pass
+
+    def leave(self, discovery_id: str) -> None:
+        pass
+
+    def on_connection(self, cb) -> None:
+        self._cb = cb
+
+    def destroy(self) -> None:
+        self._destroyed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        for d in list(self._duplexes):
+            d.close()

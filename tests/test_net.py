@@ -1,0 +1,293 @@
+"""Network layer: duplex pairs, channels, peer dedup, replication, and
+two-repo convergence over a loopback swarm (the reference's two test
+techniques, SURVEY.md §4: in-memory duplex pairs + whole-repo swarm)."""
+
+import pytest
+
+from hypermerge_tpu.net.connection import PeerConnection
+from hypermerge_tpu.net.duplex import duplex_pair
+from hypermerge_tpu.net.peer import NetworkPeer
+from hypermerge_tpu.net.replication import ReplicationManager
+from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.storage.feed import FeedStore, memory_storage_fn
+from hypermerge_tpu.utils import keys as keymod
+
+
+class TestDuplex:
+    def test_roundtrip_and_buffering(self):
+        a, b = duplex_pair()
+        got = []
+        a.send({"n": 1})  # sent before b subscribes: buffers
+        b.on_message(got.append)
+        a.send({"n": 2})
+        assert got == [{"n": 1}, {"n": 2}]
+
+    def test_close_propagates(self):
+        a, b = duplex_pair()
+        closed = []
+        b.on_close(lambda: closed.append(True))
+        a.close()
+        assert b.closed and closed == [True]
+
+
+class TestPeerConnection:
+    def test_channels_and_remote_first_buffering(self):
+        da, db = duplex_pair()
+        ca = PeerConnection(da, is_client=True)
+        cb = PeerConnection(db, is_client=False)
+        # a sends on a channel b hasn't opened yet
+        ca.open_channel("late").send({"x": 1})
+        got = []
+        cb.open_channel("late").subscribe(got.append)
+        assert got == [{"x": 1}]
+        # reverse direction on another channel
+        got2 = []
+        ca.open_channel("other").subscribe(got2.append)
+        cb.open_channel("other").send("hi")
+        assert got2 == ["hi"]
+
+
+class TestNetworkPeer:
+    def test_duplicate_connection_dedup(self):
+        ready = []
+        pa = NetworkPeer("idB", "idA", ready.append)  # authority (B > A)
+        pb = NetworkPeer("idA", "idB", ready.append)
+        # two simultaneous dials = two duplex pairs
+        d1a, d1b = duplex_pair()
+        d2a, d2b = duplex_pair()
+        c1a, c1b = (
+            PeerConnection(d1a, True), PeerConnection(d1b, False),
+        )
+        c2a, c2b = (
+            PeerConnection(d2a, False), PeerConnection(d2b, True),
+        )
+        pa.add_connection(c1a)
+        pb.add_connection(c1b)
+        pa.add_connection(c2a)
+        pb.add_connection(c2b)
+        # authority picked for both sides; exactly one live connection each
+        assert pa.is_connected and pb.is_connected
+        assert len(ready) == 2
+        live_a = [c for c in (c1a, c2a) if c.is_open]
+        live_b = [c for c in (c1b, c2b) if c.is_open]
+        assert len(live_a) == 1 and len(live_b) == 1
+
+
+class TestReplication:
+    def _mgr(self):
+        feeds = FeedStore(memory_storage_fn)
+        events = []
+        mgr = ReplicationManager(
+            feeds, lambda pk, peer: events.append(pk)
+        )
+        return feeds, mgr, events
+
+    def _connect(self, mgr_a, mgr_b):
+        da, db = duplex_pair()
+        ca, cb = PeerConnection(da, True), PeerConnection(db, False)
+        ready = []
+        pa = NetworkPeer("B", "A", ready.append)
+        pb = NetworkPeer("A", "B", ready.append)
+        pa.add_connection(ca)
+        pb.add_connection(cb)
+        mgr_a.on_peer(pa)
+        mgr_b.on_peer(pb)
+        return pa, pb
+
+    def test_shared_feed_replicates_both_directions(self):
+        feeds_a, mgr_a, ev_a = self._mgr()
+        feeds_b, mgr_b, ev_b = self._mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        fa.append(b"one")
+        fa.append(b"two")
+        fb = feeds_b.open_feed(pair.public_key)  # knows the key, no data
+        self._connect(mgr_a, mgr_b)
+        assert fb.read_all() == [b"one", b"two"]
+        assert ev_a and ev_b  # discovery fired on both sides
+        # live tail after connect
+        fa.append(b"three")
+        assert fb.read_all() == [b"one", b"two", b"three"]
+
+    def test_unknown_feed_not_replicated(self):
+        feeds_a, mgr_a, _ = self._mgr()
+        feeds_b, mgr_b, ev_b = self._mgr()
+        fa = feeds_a.create(keymod.create())
+        fa.append(b"secret")
+        self._connect(mgr_a, mgr_b)
+        # b never learns the public key, so nothing arrives
+        assert not ev_b
+        assert feeds_b.known_discovery_ids() == []
+
+    def test_late_feed_announcement(self):
+        feeds_a, mgr_a, _ = self._mgr()
+        feeds_b, mgr_b, _ = self._mgr()
+        self._connect(mgr_a, mgr_b)
+        pair = keymod.create()
+        fb = feeds_b.open_feed(pair.public_key)
+        fa = feeds_a.create(pair)  # created after connection
+        mgr_a.announce(fa)
+        mgr_b.announce(fb)
+        fa.append(b"late")
+        assert fb.read_all() == [b"late"]
+
+
+class TestTwoRepos:
+    """Whole-repo convergence over a loopback swarm (reference
+    tests/multiple-repos.test.ts)."""
+
+    def _pair(self):
+        hub = LoopbackHub()
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        ra.set_swarm(LoopbackSwarm(hub))
+        rb.set_swarm(LoopbackSwarm(hub))
+        return ra, rb
+
+    def test_share_a_doc(self):
+        ra, rb = self._pair()
+        url = ra.create({"hello": "world"})
+        doc = rb.doc(url)
+        assert doc == {"hello": "world"}
+        ra.close()
+        rb.close()
+
+    def test_bidirectional_edits(self):
+        ra, rb = self._pair()
+        url = ra.create({"from_a": 1})
+        assert rb.doc(url)["from_a"] == 1
+        rb.change(url, lambda d: d.__setitem__("from_b", 2))
+        assert ra.doc(url) == {"from_a": 1, "from_b": 2}
+        ra.change(url, lambda d: d.__setitem__("from_a", 11))
+        assert rb.doc(url) == {"from_a": 11, "from_b": 2}
+        ra.close()
+        rb.close()
+
+    def test_watch_remote_updates(self):
+        ra, rb = self._pair()
+        url = ra.create({"n": 0})
+        seen = []
+        h = rb.open(url).subscribe(lambda doc, _i: seen.append(doc.get("n")))
+        for i in range(1, 4):
+            ra.change(url, lambda d, i=i: d.__setitem__("n", i))
+        assert seen[-1] == 3
+        h.close()
+        ra.close()
+        rb.close()
+
+    def test_doc_message_ephemeral(self):
+        ra, rb = self._pair()
+        url = ra.create({"x": 1})
+        inbox = []
+        h = rb.open(url)
+        h.subscribe_message(inbox.append)
+        assert h.value() == {"x": 1}  # wait until replicated/connected
+        ra.message(url, {"ping": True})
+        assert inbox == [{"ping": True}]
+        h.close()
+        ra.close()
+        rb.close()
+
+    def test_three_repos_converge(self):
+        hub = LoopbackHub()
+        repos = [Repo(memory=True) for _ in range(3)]
+        for r in repos:
+            r.set_swarm(LoopbackSwarm(hub))
+        url = repos[0].create({"base": True})
+        for i, r in enumerate(repos):
+            r.change(url, lambda d, i=i: d.__setitem__(f"r{i}", i))
+        docs = [r.doc(url) for r in repos]
+        assert docs[0] == docs[1] == docs[2]
+        assert docs[0] == {"base": True, "r0": 0, "r1": 1, "r2": 2}
+        for r in repos:
+            r.close()
+
+
+class TestTcp:
+    """Real-socket transport: two repos converge over localhost TCP."""
+
+    def test_two_repos_over_tcp(self):
+        import time
+
+        from hypermerge_tpu.net.tcp import TcpSwarm
+
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"over": "tcp"})
+        doc = rb.open(url).value(timeout=10)
+        assert doc == {"over": "tcp"}
+        rb.change(url, lambda d: d.__setitem__("back", True))
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if ra.doc(url).get("back"):
+                break
+            time.sleep(0.05)
+        assert ra.doc(url) == {"over": "tcp", "back": True}
+        ra.close()
+        rb.close()
+
+
+class TestChurn:
+    def test_reconnect_resumes_replication(self):
+        """After the transport drops, a redial must renegotiate feeds and
+        deliver new changes (per-connection channel wiring + replication
+        reset on disconnect)."""
+        import time
+
+        from hypermerge_tpu.net.tcp import TcpSwarm
+
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"v": 1})
+        assert rb.open(url).value(timeout=10)["v"] == 1
+
+        # hard-drop every transport on b's side
+        for d in list(sb._duplexes):
+            d.close()
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            peer = next(iter(rb.back.network.peers.values()), None)
+            if peer is not None and not peer.is_connected:
+                break
+            time.sleep(0.05)
+
+        # change while disconnected, then redial
+        ra.change(url, lambda d: d.__setitem__("v", 2))
+        sb.connect(sa.address)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if rb.doc(url).get("v") == 2:
+                break
+            time.sleep(0.05)
+        assert rb.doc(url)["v"] == 2
+        ra.close()
+        rb.close()
+
+    def test_malformed_peer_messages_survive(self):
+        """Garbage on the Msgs/Replication channels must not kill sync."""
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        hub = LoopbackHub()
+        ra.set_swarm(LoopbackSwarm(hub))
+        rb.set_swarm(LoopbackSwarm(hub))
+        url = ra.create({"x": 1})
+        assert rb.doc(url) == {"x": 1}
+        # inject malformed frames from a's side toward b
+        peer = next(iter(ra.back.network.peers.values()))
+        ch = peer.connection.open_channel("Msgs")
+        ch.send({"type": "CursorMessage"})  # missing fields
+        ch.send({"type": "DocumentMessage"})
+        ch.send(42)
+        rch = peer.connection.open_channel("Replication")
+        rch.send({"type": "Blocks", "id": "nope", "from": "NaN", "blocks": 3})
+        rch.send({"type": "FeedLength"})
+        # sync still works afterwards
+        ra.change(url, lambda d: d.__setitem__("x", 2))
+        assert rb.doc(url)["x"] == 2
+        ra.close()
+        rb.close()
