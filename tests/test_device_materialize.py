@@ -112,6 +112,28 @@ def test_batch_many_docs():
     assert cols["clock"].shape[0] == 7
 
 
+def test_device_summary_equals_host_decode():
+    # summarize_columnar (fused on-device summary, bit-packed transfer)
+    # must agree exactly with decode_columnar (host numpy reference)
+    from hypermerge_tpu.ops.materialize import summarize_columnar
+
+    rng = random.Random(7)
+    sites = [Site(f"s{i}") for i in range(5)]
+    for _ in range(60):
+        random_mutation(rng.choice(sites), rng)
+    for i in range(len(sites) - 1):
+        sync(sites[i], sites[i + 1])
+    histories = [list(s.opset.history) for s in sites]
+    batch = columnar.pack_docs(histories)
+    dec = materialize_batch(histories)
+    host = decode_columnar(dec)
+    dev = summarize_columnar(batch)
+    for k in host:
+        np.testing.assert_array_equal(
+            np.asarray(host[k]), np.asarray(dev[k]), err_msg=k
+        )
+
+
 def test_text_join_fast_path():
     s = Site("alice")
     s.change(lambda d: d.__setitem__("t", Text("hello")))
