@@ -39,8 +39,10 @@ from ..utils import keys as keymod
 from ..utils.debug import log
 from ..utils.ids import root_actor_id
 from ..utils.queue import Queue
+from ..files.file_store import FileStore
 from .actor import Actor
 from .doc_backend import DocBackend
+from .metadata import Metadata
 
 
 class RepoBackend:
@@ -71,6 +73,9 @@ class RepoBackend:
         self.to_frontend: Queue = Queue("backend:toFrontend")
         self._query_handlers: Dict[str, Callable] = {}
         self.network = None  # attached by setSwarm (net/, M7)
+        self.meta = Metadata(self.feeds, self.key_store)
+        self.file_store: Optional[FileStore] = None
+        self._file_server = None
         self._closed = False
 
     # ------------------------------------------------------------------
@@ -378,7 +383,9 @@ class RepoBackend:
         elif t == "Metadata":
             doc = self.docs.get(query["id"])
             if doc is None:
-                payload = None
+                # Not an open doc: maybe a hyperfile in the ledger
+                # (reference src/RepoBackend.ts:560-568 consults Metadata).
+                payload = self.meta.file_metadata(query["id"])
             else:
                 payload = {
                     "type": "Document",
@@ -444,9 +451,21 @@ class RepoBackend:
             )
 
     def start_file_server(self, path: str) -> None:
-        from ..files.file_server import FileServer  # files subsystem
+        from ..files.file_server import FileServer
 
-        self._file_server = FileServer(self)
+        if self._file_server is not None:
+            raise RuntimeError(
+                "file server already listening; one per repo backend"
+            )
+        self.file_store = FileStore(self.feeds)
+        # Completed uploads flow into the durable metadata ledger
+        # (reference src/RepoBackend.ts:105-107 → Metadata.addFile).
+        self.file_store.write_log.subscribe(
+            lambda header: self.meta.add_file(
+                header.url, header.size, header.mime_type
+            )
+        )
+        self._file_server = FileServer(self.file_store)
         self._file_server.listen(path)
         self.to_frontend.push(msgs.file_server_ready_msg(path))
 
@@ -461,6 +480,9 @@ class RepoBackend:
 
     def close(self) -> None:
         self._closed = True
+        if self._file_server is not None:
+            self._file_server.close()
+            self._file_server = None
         if self.network is not None:
             self.network.close()
         self.feeds.close()

@@ -1,0 +1,116 @@
+"""FileStore: write-once binary blobs stored as chunked feeds.
+
+Parity: reference src/FileStore.ts:20-80 — write chunks data at
+MAX_BLOCK_SIZE, sha256s while streaming, and appends a JSON header block
+LAST (so a feed whose tail parses as a header is a complete upload);
+read streams every block except the trailing header; header reads just
+the head block.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..storage.feed import FeedStore
+from ..utils import json_buffer
+from ..utils import keys as keymod
+from ..utils.ids import to_hyperfile_url, url_to_id
+from ..utils.queue import Queue
+from .stream_logic import MAX_BLOCK_SIZE, Chunkable, HashCounter, iter_chunks, rechunk
+
+
+@dataclass(frozen=True)
+class FileHeader:
+    """The trailing header block (reference src/FileStore.ts:44-67:
+    `{type: 'File', url, bytes, mimeType, sha256}`)."""
+
+    url: str
+    size: int
+    mime_type: str
+    sha256: str
+    blocks: int  # data blocks, header excluded
+
+    def to_json(self) -> dict:
+        return {
+            "type": "File",
+            "url": self.url,
+            "bytes": self.size,
+            "mimeType": self.mime_type,
+            "sha256": self.sha256,
+            "blocks": self.blocks,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "FileHeader":
+        if obj.get("type") != "File":
+            raise ValueError(f"not a file header: {obj!r}")
+        return FileHeader(
+            url=obj["url"],
+            size=obj["bytes"],
+            mime_type=obj["mimeType"],
+            sha256=obj["sha256"],
+            blocks=obj.get("blocks", -1),
+        )
+
+
+class FileStore:
+    """Writes/reads hyperfiles over a FeedStore. Completed writes are
+    announced on `write_log` (the backend's Metadata ledger subscribes —
+    reference src/RepoBackend.ts:105-107)."""
+
+    def __init__(self, feeds: FeedStore) -> None:
+        self.feeds = feeds
+        self.write_log: Queue = Queue("filestore:writelog")
+
+    def write(self, data: Chunkable, mime_type: str) -> FileHeader:
+        pair = keymod.create()
+        feed = self.feeds.create(pair)
+        counter = HashCounter()
+        n_blocks = 0
+        for chunk in counter.wrap(rechunk(iter_chunks(data), MAX_BLOCK_SIZE)):
+            feed.append(chunk)
+            n_blocks += 1
+        header = FileHeader(
+            url=to_hyperfile_url(pair.public_key),
+            size=counter.bytes,
+            mime_type=mime_type,
+            sha256=counter.digest_hex,
+            blocks=n_blocks,
+        )
+        feed.append(json_buffer.bufferify(header.to_json()))  # header LAST
+        self.write_log.push(header)
+        return header
+
+    def _existing_feed(self, file_id: str):
+        # open_if_present, not open_feed: a lookup for an unknown id must
+        # not create (and forever register/announce) an empty feed, but a
+        # feed persisted by a previous run must still be reachable.
+        feed = self.feeds.open_if_present(file_id)
+        if feed is None or feed.length == 0:
+            raise FileNotFoundError(f"hyperfile {file_id} has no blocks")
+        return feed
+
+    def header(self, file_id: str) -> FileHeader:
+        feed = self._existing_feed(file_id)
+        try:
+            return FileHeader.from_json(
+                json_buffer.parse(feed.get(feed.length - 1))
+            )
+        except (ValueError, KeyError) as exc:
+            # tail block isn't a header: incomplete upload or not a file
+            raise FileNotFoundError(f"hyperfile {file_id}: {exc}") from exc
+
+    def read(self, file_id: str) -> Iterator[bytes]:
+        """Stream every data block (all blocks except the trailing
+        header, reference src/FileStore.ts:33-36)."""
+        feed = self._existing_feed(file_id)
+        for i in range(feed.length - 1):
+            yield feed.get(i)
+
+    def read_bytes(self, file_id: str) -> bytes:
+        return b"".join(self.read(file_id))
+
+    @staticmethod
+    def id_of(url: str) -> str:
+        return url_to_id(url)

@@ -210,6 +210,22 @@ class FeedStore:
         with self._lock:
             return self._feeds.get(public_key)
 
+    def open_if_present(self, public_key: str) -> Optional[Feed]:
+        """Open a feed only if its storage already holds blocks (e.g.
+        persisted from a previous run). Unlike open_feed this never
+        registers/announces an empty feed for an unknown key — lookups
+        for bogus ids must not pollute the store."""
+        with self._lock:
+            feed = self._feeds.get(public_key)
+            if feed is not None:
+                return feed
+            storage = self._storage_fn(public_key)
+            has_blocks = len(storage) > 0
+            storage.close()  # _open builds its own storage instance
+            if not has_blocks:
+                return None
+        return self._open(public_key, None)
+
     def by_discovery_id(self, discovery_id: str) -> Optional[Feed]:
         with self._lock:
             pk = self._by_discovery.get(discovery_id)

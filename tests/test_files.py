@@ -1,0 +1,208 @@
+"""Hyperfile subsystem: chunking, FileStore, server round trip, ledger.
+
+Parity targets: reference tests/StreamLogic.test.ts (chunk edge cases),
+tests/FileStore.test.ts:15-35 (1MiB file -> 17 blocks @62KiB, sha256
+header round trip), tests/repo.test.ts:199-213 (file round trip through
+the repo facade)."""
+
+import hashlib
+import os
+import tempfile
+import uuid
+
+import pytest
+
+from hypermerge_tpu.backend.metadata import Metadata
+from hypermerge_tpu.files.file_store import FileHeader, FileStore
+from hypermerge_tpu.files.stream_logic import (
+    MAX_BLOCK_SIZE,
+    HashCounter,
+    iter_chunks,
+    rechunk,
+)
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.storage.feed import FeedStore, memory_storage_fn
+from hypermerge_tpu.utils.ids import url_to_id
+
+
+def server_path() -> str:
+    return os.path.join(
+        tempfile.gettempdir(), f"hypermerge-tpu-test-{uuid.uuid4().hex[:8]}.sock"
+    )
+
+
+# -- stream logic -------------------------------------------------------
+
+
+def test_rechunk_passthrough_small_chunks():
+    chunks = [b"ab", b"cd", b"e"]
+    assert list(rechunk(chunks, 4)) == [b"ab", b"cd", b"e"]
+
+
+def test_rechunk_splits_oversized():
+    out = list(rechunk([b"abcdefghij"], 4))
+    assert out == [b"abcd", b"efgh", b"ij"]
+    assert b"".join(out) == b"abcdefghij"
+
+
+def test_rechunk_exact_multiple_and_empty():
+    assert list(rechunk([b"abcd"], 4)) == [b"abcd"]
+    assert list(rechunk([b""], 4)) == []
+    assert list(rechunk([], 4)) == []
+
+
+def test_iter_chunks_normalizes_bytes_and_iterables():
+    assert list(iter_chunks(b"xyz")) == [b"xyz"]
+    assert list(iter_chunks([b"x", b"yz"])) == [b"x", b"yz"]
+
+
+def test_hash_counter():
+    c = HashCounter()
+    data = [b"hello ", b"world"]
+    assert list(c.wrap(data)) == data
+    assert c.bytes == 11
+    assert c.chunks == 2
+    assert c.digest_hex == hashlib.sha256(b"hello world").hexdigest()
+
+
+# -- FileStore ----------------------------------------------------------
+
+
+@pytest.fixture
+def store():
+    return FileStore(FeedStore(memory_storage_fn))
+
+
+def test_one_mib_file_is_17_blocks(store):
+    """1MiB at 62KiB chunks = 17 data blocks (reference
+    tests/FileStore.test.ts:15-35)."""
+    data = os.urandom(1024 * 1024)
+    header = store.write(data, "application/octet-stream")
+    assert header.blocks == 17
+    assert header.size == len(data)
+    assert header.sha256 == hashlib.sha256(data).hexdigest()
+    file_id = url_to_id(header.url)
+    assert store.read_bytes(file_id) == data
+    # feed holds data blocks + ONE trailing header block
+    feed = store.feeds.get_feed(file_id)
+    assert feed.length == 18
+    assert max(len(b) for b in feed.read_all()[:-1]) <= MAX_BLOCK_SIZE
+
+
+def test_header_round_trip(store):
+    header = store.write(b"hello", "text/plain")
+    got = store.header(url_to_id(header.url))
+    assert got == header
+    assert got.mime_type == "text/plain"
+    assert FileHeader.from_json(header.to_json()) == header
+
+
+def test_empty_file(store):
+    header = store.write(b"", "text/plain")
+    assert header.blocks == 0
+    assert header.size == 0
+    assert store.read_bytes(url_to_id(header.url)) == b""
+
+
+def test_write_log_announces_completed_uploads(store):
+    seen = []
+    store.write_log.subscribe(seen.append)
+    h = store.write(b"abc", "text/plain")
+    assert seen == [h]
+
+
+# -- server + client through the repo facade ----------------------------
+
+
+def test_repo_file_round_trip():
+    """Write via repo.files, read back, check meta (reference
+    tests/repo.test.ts:199-213)."""
+    repo = Repo(memory=True)
+    path = server_path()
+    try:
+        repo.start_file_server(path)
+        assert repo.files is not None
+        data = os.urandom(200 * 1024)
+        header = repo.files.write(data, "application/x-test")
+        assert header.size == len(data)
+        assert header.blocks == 4  # ceil(200KiB / 62KiB)
+
+        got_header, body = repo.files.read(header.url)
+        assert body == data
+        assert got_header.sha256 == hashlib.sha256(data).hexdigest()
+        assert got_header.mime_type == "application/x-test"
+        assert repo.files.header(header.url) == got_header
+
+        # meta() resolves hyperfile urls from the ledger
+        metas = []
+        repo.meta(header.url, metas.append)
+        assert metas == [
+            {
+                "type": "File",
+                "bytes": len(data),
+                "mimeType": "application/x-test",
+            }
+        ]
+    finally:
+        repo.close()
+        assert not os.path.exists(path)
+
+
+def test_file_server_missing_file_404():
+    repo = Repo(memory=True)
+    path = server_path()
+    try:
+        repo.start_file_server(path)
+        from hypermerge_tpu.utils import keys
+
+        bogus = f"hyperfile:/{keys.create().public_key}"
+        with pytest.raises(FileNotFoundError):
+            repo.files.header(bogus)
+        # a 404 lookup must not create/register a feed for the bogus id
+        assert repo.back.feeds.get_feed(url_to_id(bogus)) is None
+    finally:
+        repo.close()
+
+
+# -- metadata ledger ----------------------------------------------------
+
+
+def test_metadata_ledger_persists_across_restart(tmp_path):
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    sock = server_path()
+    try:
+        repo.start_file_server(sock)
+        header = repo.files.write(b"persistent", "text/plain")
+    finally:
+        repo.close()
+
+    repo2 = Repo(path=path)
+    try:
+        file_id = url_to_id(header.url)
+        assert repo2.back.meta.file_metadata(file_id) == {
+            "type": "File",
+            "bytes": 10,
+            "mimeType": "text/plain",
+        }
+        # the file bytes themselves also survive
+        assert FileStore(repo2.back.feeds).read_bytes(file_id) == b"persistent"
+    finally:
+        repo2.close()
+
+
+def test_metadata_ledger_skips_corrupt_entries():
+    from hypermerge_tpu.storage.sql import SqlDatabase
+    from hypermerge_tpu.storage.stores import KeyStore
+
+    from hypermerge_tpu.utils import keys
+
+    feeds = FeedStore(memory_storage_fn)
+    key_store = KeyStore(SqlDatabase(":memory:"))
+    meta = Metadata(feeds, key_store)
+    meta.add_file(f"hyperfile:/{keys.create().public_key}", 5, "a/b")
+    meta.ledger.append(b"\xff\xfenot json")  # corrupt entry
+    meta.add_file(f"hyperfile:/{keys.create().public_key}", 6, "c/d")
+
+    meta2 = Metadata(feeds, key_store)  # replay over the same feed
+    assert len(meta2.files) == 2
