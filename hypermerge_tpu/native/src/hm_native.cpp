@@ -1,0 +1,240 @@
+// hm_native: C++ native layer for hypermerge_tpu.
+//
+// Provides the primitives the reference gets from native npm addons
+// (SURVEY.md §2.4): ed25519 keypairs/signatures (sodium-native
+// equivalent), BLAKE2b hashing (discovery keys, merkle nodes), and
+// brotli block compression (iltorb equivalent), with a zlib fallback.
+//
+// The image ships runtime shared objects for libsodium and libbrotli but
+// no headers, so the stable C ABIs are declared here and the libraries
+// are dlopen'd at init; zlib has headers and is linked directly. Every
+// entry point degrades gracefully: callers check hm_caps() and fall back
+// to pure-Python implementations when a capability is absent.
+//
+// Build: make -C hypermerge_tpu/native  (produces libhm_native.so)
+
+#include <cstdint>
+#include <cstring>
+#include <dlfcn.h>
+#include <zlib.h>
+
+// ---------------------------------------------------------------------
+// dlopen'd ABIs
+
+typedef int (*fn_sodium_init)(void);
+typedef int (*fn_sign_seed_keypair)(unsigned char *, unsigned char *,
+                                    const unsigned char *);
+typedef int (*fn_sign_detached)(unsigned char *, unsigned long long *,
+                                const unsigned char *, unsigned long long,
+                                const unsigned char *);
+typedef int (*fn_sign_verify_detached)(const unsigned char *,
+                                       const unsigned char *,
+                                       unsigned long long,
+                                       const unsigned char *);
+typedef int (*fn_generichash)(unsigned char *, size_t, const unsigned char *,
+                              unsigned long long, const unsigned char *,
+                              size_t);
+
+typedef int (*fn_brotli_compress)(int, int, int, size_t, const uint8_t *,
+                                  size_t *, uint8_t *);
+typedef int (*fn_brotli_decompress)(size_t, const uint8_t *, size_t *,
+                                    uint8_t *);
+typedef size_t (*fn_brotli_bound)(size_t);
+
+static fn_sign_seed_keypair p_seed_keypair = nullptr;
+static fn_sign_detached p_sign = nullptr;
+static fn_sign_verify_detached p_verify = nullptr;
+static fn_generichash p_generichash = nullptr;
+static fn_brotli_compress p_br_compress = nullptr;
+static fn_brotli_decompress p_br_decompress = nullptr;
+static fn_brotli_bound p_br_bound = nullptr;
+
+static const int CAP_SODIUM = 1;
+static const int CAP_BROTLI = 2;
+static const int CAP_ZLIB = 4;
+static int g_caps = -1;
+
+extern "C" {
+
+int hm_init(void) {
+  if (g_caps >= 0)
+    return g_caps;
+  int caps = CAP_ZLIB; // linked directly
+
+  void *sodium = dlopen("libsodium.so.23", RTLD_NOW | RTLD_GLOBAL);
+  if (!sodium)
+    sodium = dlopen("libsodium.so", RTLD_NOW | RTLD_GLOBAL);
+  if (sodium) {
+    fn_sodium_init init =
+        (fn_sodium_init)dlsym(sodium, "sodium_init");
+    p_seed_keypair =
+        (fn_sign_seed_keypair)dlsym(sodium, "crypto_sign_seed_keypair");
+    p_sign = (fn_sign_detached)dlsym(sodium, "crypto_sign_detached");
+    p_verify = (fn_sign_verify_detached)dlsym(
+        sodium, "crypto_sign_verify_detached");
+    p_generichash = (fn_generichash)dlsym(sodium, "crypto_generichash");
+    if (init && init() >= 0 && p_seed_keypair && p_sign && p_verify &&
+        p_generichash)
+      caps |= CAP_SODIUM;
+  }
+
+  void *enc = dlopen("libbrotlienc.so.1", RTLD_NOW);
+  if (!enc)
+    enc = dlopen("libbrotlienc.so", RTLD_NOW);
+  void *dec = dlopen("libbrotlidec.so.1", RTLD_NOW);
+  if (!dec)
+    dec = dlopen("libbrotlidec.so", RTLD_NOW);
+  if (enc && dec) {
+    p_br_compress = (fn_brotli_compress)dlsym(enc, "BrotliEncoderCompress");
+    p_br_bound = (fn_brotli_bound)dlsym(enc, "BrotliEncoderMaxCompressedSize");
+    p_br_decompress =
+        (fn_brotli_decompress)dlsym(dec, "BrotliDecoderDecompress");
+    if (p_br_compress && p_br_decompress && p_br_bound)
+      caps |= CAP_BROTLI;
+  }
+
+  g_caps = caps;
+  return caps;
+}
+
+int hm_caps(void) { return hm_init(); }
+
+// -------------------------------------------------------------------
+// ed25519 (requires CAP_SODIUM; returns -2 when unavailable)
+
+int hm_ed25519_public(const uint8_t seed[32], uint8_t pub[32]) {
+  if (!(hm_init() & CAP_SODIUM))
+    return -2;
+  uint8_t sk[64];
+  return p_seed_keypair(pub, sk, seed) == 0 ? 0 : -1;
+}
+
+int hm_ed25519_sign(const uint8_t seed[32], const uint8_t *msg, size_t len,
+                    uint8_t sig[64]) {
+  if (!(hm_init() & CAP_SODIUM))
+    return -2;
+  uint8_t pk[32], sk[64];
+  if (p_seed_keypair(pk, sk, seed) != 0)
+    return -1;
+  unsigned long long siglen = 64;
+  return p_sign(sig, &siglen, msg, (unsigned long long)len, sk) == 0 ? 0 : -1;
+}
+
+int hm_ed25519_verify(const uint8_t pub[32], const uint8_t *msg, size_t len,
+                      const uint8_t sig[64]) {
+  if (!(hm_init() & CAP_SODIUM))
+    return -2;
+  return p_verify(sig, msg, (unsigned long long)len, pub) == 0 ? 1 : 0;
+}
+
+// -------------------------------------------------------------------
+// BLAKE2b (keyed) — discovery keys + merkle nodes
+
+int hm_blake2b(const uint8_t *data, size_t len, const uint8_t *key,
+               size_t keylen, uint8_t *out, size_t outlen) {
+  if (!(hm_init() & CAP_SODIUM))
+    return -2;
+  return p_generichash(out, outlen, data, (unsigned long long)len, key,
+                       keylen) == 0
+             ? 0
+             : -1;
+}
+
+// -------------------------------------------------------------------
+// Merkle root over leaf hashes (32-byte nodes): parent =
+// blake2b32(0x01 || left || right); an odd trailing node is promoted.
+// Leaf hashing (0x00 || block) is done by the caller per block.
+
+int hm_merkle_root(const uint8_t *leaves, size_t n, uint8_t out[32]) {
+  if (!(hm_init() & CAP_SODIUM))
+    return -2;
+  if (n == 0) {
+    memset(out, 0, 32);
+    return 0;
+  }
+  // work buffer: copy of current level
+  uint8_t *level = new uint8_t[n * 32];
+  memcpy(level, leaves, n * 32);
+  size_t count = n;
+  uint8_t node[65];
+  node[0] = 0x01;
+  while (count > 1) {
+    size_t next = 0;
+    for (size_t i = 0; i + 1 < count; i += 2) {
+      memcpy(node + 1, level + i * 32, 32);
+      memcpy(node + 33, level + (i + 1) * 32, 32);
+      if (p_generichash(level + next * 32, 32, node, 65, nullptr, 0) != 0) {
+        delete[] level;
+        return -1;
+      }
+      next++;
+    }
+    if (count % 2 == 1) { // odd node promoted
+      memcpy(level + next * 32, level + (count - 1) * 32, 32);
+      next++;
+    }
+    count = next;
+  }
+  memcpy(out, level, 32);
+  delete[] level;
+  return 0;
+}
+
+// -------------------------------------------------------------------
+// Block codec. codec: 1 = brotli, 2 = zlib. Returns compressed size,
+// -1 on error, -2 if codec unavailable. Caller sizes `out` with
+// hm_compress_bound.
+
+size_t hm_compress_bound(size_t len) {
+  size_t z = compressBound((uLong)len);
+  if (hm_init() & CAP_BROTLI) {
+    size_t b = p_br_bound(len);
+    if (b > z)
+      z = b;
+  }
+  return z;
+}
+
+long hm_compress(int codec, int quality, const uint8_t *in, size_t len,
+                 uint8_t *out, size_t cap) {
+  int caps = hm_init();
+  if (codec == 1) {
+    if (!(caps & CAP_BROTLI))
+      return -2;
+    size_t outlen = cap;
+    // lgwin 22, mode 0 (generic) — quality per caller (reference iltorb
+    // default quality is 11; block packing wants speed, callers pass ~5)
+    if (p_br_compress(quality, 22, 0, len, in, &outlen, out) != 1)
+      return -1;
+    return (long)outlen;
+  }
+  if (codec == 2) {
+    uLongf outlen = (uLongf)cap;
+    if (compress2(out, &outlen, in, (uLong)len, quality) != Z_OK)
+      return -1;
+    return (long)outlen;
+  }
+  return -2;
+}
+
+long hm_decompress(int codec, const uint8_t *in, size_t len, uint8_t *out,
+                   size_t cap) {
+  int caps = hm_init();
+  if (codec == 1) {
+    if (!(caps & CAP_BROTLI))
+      return -2;
+    size_t outlen = cap;
+    if (p_br_decompress(len, in, &outlen, out) != 1)
+      return -1;
+    return (long)outlen;
+  }
+  if (codec == 2) {
+    uLongf outlen = (uLongf)cap;
+    if (uncompress(out, &outlen, in, (uLong)len) != Z_OK)
+      return -1;
+    return (long)outlen;
+  }
+  return -2;
+}
+
+} // extern "C"
