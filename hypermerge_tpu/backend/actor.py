@@ -4,6 +4,15 @@ Parity: reference src/Actor.ts:44-142 — writes local changes as packed
 blocks (seq continuity asserted against feed length), parses downloaded
 blocks back into changes, and emits lifecycle events
 (ActorInitialized / ActorSync / Download) to the RepoBackend hub.
+
+TPU-first deltas from the reference:
+- Block decode is **lazy**: opening an actor does not JSON-decode its
+  feed (the reference parses every block on feed ready,
+  src/Actor.ts:105-117). The interactive path decodes on first access;
+  the bulk cold-start path never decodes at all — it reads the columnar
+  sidecar via `columns()`.
+- The actor maintains the feed's columnar cache (storage/colcache.py)
+  on every append, local or replicated, so cold starts stay vectorized.
 """
 
 from __future__ import annotations
@@ -14,8 +23,15 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..crdt.change import Change
 from ..storage import block as blockmod
+from ..storage.colcache import (
+    FeedColumnCache,
+    FeedColumns,
+    MemoryColumnStorage,
+)
 from ..storage.feed import Feed
 from ..utils.debug import log
+
+_UNSET = object()  # block present but not yet decoded
 
 
 class Actor:
@@ -28,8 +44,11 @@ class Actor:
         self.feed = feed
         self._notify = notify
         self._lock = threading.RLock()
-        self.changes: List[Optional[Change]] = []
-        self._load_existing()
+        # slot per feed block: _UNSET until decoded; None = corrupt
+        self.changes: List[Any] = [_UNSET] * feed.length
+        self._colcache: FeedColumnCache = feed.colcache or FeedColumnCache(
+            MemoryColumnStorage(), writer=self.id
+        )
         feed.on_append(self._on_append)
         self._notify({"type": "ActorInitialized", "actor": self})
         self._notify({"type": "ActorSync", "actor": self})
@@ -43,10 +62,12 @@ class Actor:
         with self._lock:
             return len(self.changes)
 
-    def _load_existing(self) -> None:
-        for index, data in enumerate(self.feed.read_all()):
-            change = self._parse_block(data, index)
-            self.changes.append(change)
+    def _get_change(self, index: int) -> Optional[Change]:
+        c = self.changes[index]
+        if c is _UNSET:
+            c = self._parse_block(self.feed.get(index), index)
+            self.changes[index] = c
+        return c
 
     def _parse_block(self, data: bytes, index: int) -> Optional[Change]:
         try:
@@ -69,6 +90,7 @@ class Actor:
                 return
             self.changes.append(change)
             self.feed.append(blockmod.pack(change.to_json()))
+            self._sync_cache_locked()
         # local writes don't re-notify sync: the doc already applied it
 
     def deliver_remote_block(self, index: int, data: bytes) -> None:
@@ -91,20 +113,41 @@ class Actor:
                 return  # our own write_change already recorded it
             change = self._parse_block(data, index)
             self.changes.append(change)
+            self._sync_cache_locked()
         self._notify({"type": "ActorSync", "actor": self})
 
-    def changes_in_window(self, start_seq: int, end_seq: float) -> List[Change]:
+    def _sync_cache_locked(self) -> None:
+        """Bring the columnar sidecar up to the feed head (decodes only
+        the blocks the cache is missing — a fresh cache over an existing
+        feed rebuilds here)."""
+        cc = self._colcache
+        n = cc.n_changes
+        head = len(self.changes)
+        for i in range(n, head):
+            cc.append_change(self._get_change(i))
+
+    def columns(self) -> FeedColumns:
+        """The feed as columnar arrays (the bulk cold-start input); the
+        sidecar is caught up first if stale."""
+        with self._lock:
+            self._sync_cache_locked()
+            return self._colcache.columns()
+
+    def changes_in_window(
+        self, start_seq: int, end_seq: float
+    ) -> List[Change]:
         """Changes with seq in (start_seq, end_seq] — the syncChanges
         window (reference src/RepoBackend.ts:513-522). seqs are 1-based;
         change at list index i has seq i+1."""
         with self._lock:
             end = min(len(self.changes), int(min(end_seq, len(self.changes))))
-            out = [
+            return [
                 c
-                for c in self.changes[start_seq:end]
+                for c in (
+                    self._get_change(i) for i in range(start_seq, end)
+                )
                 if c is not None
             ]
-            return out
 
     def close(self) -> None:
         pass

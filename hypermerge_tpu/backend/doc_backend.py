@@ -32,7 +32,13 @@ class DocBackend:
         self._lock = threading.RLock()
         self.opset: Optional[OpSet] = opset
         self.actor_id: Optional[str] = None
-        self.device_snapshot = None  # set by bulk loader before Ready
+        # deferred-init state (bulk cold start, repo_backend
+        # load_documents_bulk): readiness/clock/snapshot served without a
+        # host OpSet; the OpSet reconstructs lazily on first change
+        self._lazy_loader: Optional[Callable[[], List[Change]]] = None
+        self._lazy_clock: Optional[clockmod.Clock] = None
+        self._lazy_len = 0
+        self._snapshot_fn: Optional[Callable[[], Any]] = None
         self.ready = Queue(f"doc:{doc_id[:6]}:ready")
         self._announced = False
         self.minimum_clock: Optional[clockmod.Clock] = None
@@ -46,14 +52,27 @@ class DocBackend:
     # ------------------------------------------------------------------
 
     @property
+    def can_apply(self) -> bool:
+        """True once the doc can absorb changes — either a live OpSet or
+        the deferred-init state (which reconstructs one on demand)."""
+        with self._lock:
+            return self.opset is not None or self._lazy_loader is not None
+
+    @property
     def clock(self) -> clockmod.Clock:
         with self._lock:
-            return dict(self.opset.clock) if self.opset else {}
+            if self.opset is not None:
+                return dict(self.opset.clock)
+            if self._lazy_clock is not None:
+                return dict(self._lazy_clock)
+            return {}
 
     @property
     def history_len(self) -> int:
         with self._lock:
-            return len(self.opset.history) if self.opset else 0
+            if self.opset is not None:
+                return len(self.opset.history)
+            return self._lazy_len
 
     def init(self, changes: List[Change], actor_id: Optional[str]) -> None:
         """Cold-start materialization (reference DocBackend.init — the
@@ -66,6 +85,44 @@ class DocBackend:
             if actor_id is not None:
                 self.actor_id = actor_id
         self._check_ready()
+
+    def init_deferred(
+        self,
+        loader: Callable[[], List[Change]],
+        clock: clockmod.Clock,
+        history_len: int,
+        actor_id: Optional[str],
+        snapshot_fn: Callable[[], Any],
+        quiet: bool = True,
+    ) -> None:
+        """Bulk cold start: the device already materialized this doc, so
+        readiness, clock, and the Ready snapshot serve without replaying
+        the history through the host OpSet. The OpSet reconstructs
+        lazily (via `loader`) the first time an incremental change needs
+        it — the dual-path seam of SURVEY.md §7.3 item 4."""
+        with self._lock:
+            if self.opset is not None:
+                return  # raced with a normal init: host state wins
+            self._lazy_loader = loader
+            self._lazy_clock = dict(clock)
+            self._lazy_len = history_len
+            self._snapshot_fn = snapshot_fn
+            if actor_id is not None:
+                self.actor_id = actor_id
+        self._check_ready(quiet=quiet)
+
+    def _ensure_opset(self) -> None:
+        """Reconstruct the host OpSet from feed history (lazy path)."""
+        with self._lock:
+            if self.opset is not None:
+                return
+            self.opset = OpSet()
+            loader, self._lazy_loader = self._lazy_loader, None
+            self._lazy_clock = None
+            self._snapshot_fn = None
+            if loader is not None:
+                with bench("doc:lazyReplay"):
+                    self.opset.apply_changes(loader())
 
     def set_actor_id(self, actor_id: str) -> None:
         with self._lock:
@@ -94,36 +151,52 @@ class DocBackend:
 
     def materialize_at(self, n: int):
         with self._lock:
-            if self.opset is None:
+            if self.opset is None and self._lazy_loader is None:
                 return None
+            self._ensure_opset()
             return self.opset.materialize_at(n)
+
+    def history_patch(self, n: int):
+        """Snapshot patch of the first n history changes (time travel;
+        reconstructs the OpSet if this doc was bulk-loaded)."""
+        with self._lock:
+            if self.opset is None and self._lazy_loader is None:
+                return None
+            self._ensure_opset()
+            sub = OpSet()
+            sub.apply_changes(self.opset.history[:n])
+            return sub.snapshot_patch()
 
     def snapshot_patch(self):
         with self._lock:
+            if self.opset is None and self._snapshot_fn is not None:
+                return self._snapshot_fn()
             return self.opset.snapshot_patch() if self.opset else None
 
     # ------------------------------------------------------------------
 
     def _minimum_satisfied(self) -> bool:
-        if self.opset is None:
+        if self.opset is None and self._lazy_clock is None:
             return False
         if self.minimum_clock is None:
             return True
-        return clockmod.gte(self.opset.clock, self.minimum_clock)
+        return clockmod.gte(self.clock, self.minimum_clock)
 
-    def _check_ready(self) -> None:
+    def _check_ready(self, quiet: bool = False) -> None:
         with self._lock:
             if self._announced or not self._minimum_satisfied():
                 return
             self._announced = True
         log("doc:back", self.id[:6], "ready")
-        self._notify({"type": "DocReady", "doc": self})
+        self._notify(
+            {"type": "DocReadyQuiet" if quiet else "DocReady", "doc": self}
+        )
         self.ready.push(True)
 
     def _handle_local(self, req: ChangeRequest) -> None:
         with self._lock:
             if self.opset is None:
-                self.opset = OpSet()
+                self._ensure_opset()
             with bench("doc:applyLocalChange"):
                 try:
                     change, patch = self.opset.apply_local_request(req)
@@ -143,7 +216,7 @@ class DocBackend:
     def _handle_remote(self, changes: List[Change]) -> None:
         with self._lock:
             if self.opset is None:
-                self.opset = OpSet()
+                self._ensure_opset()
             with bench("doc:applyRemoteChanges"):
                 patch = self.opset.apply_changes(changes)
         if self._announced and not patch.is_empty:

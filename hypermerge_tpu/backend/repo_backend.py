@@ -23,6 +23,10 @@ from .. import msgs
 from ..crdt import clock as clockmod
 from ..crdt.change import Change, ChangeRequest
 from ..crdt.opset import OpSet
+from ..storage.colcache import (
+    file_column_storage_fn,
+    memory_column_storage_fn,
+)
 from ..storage.feed import (
     FeedStore,
     file_storage_fn,
@@ -55,9 +59,11 @@ class RepoBackend:
         self.memory = memory
         if memory:
             storage_fn = memory_storage_fn
+            cache_fn = memory_column_storage_fn
             db_path = ":memory:"
         else:
             storage_fn = file_storage_fn(os.path.join(path, "feeds"))
+            cache_fn = file_column_storage_fn(os.path.join(path, "feeds"))
             os.makedirs(path, exist_ok=True)
             db_path = os.path.join(path, "repo.db")
         self.db = SqlDatabase(db_path)
@@ -65,7 +71,7 @@ class RepoBackend:
         self.cursors = CursorStore(self.db)
         self.key_store = KeyStore(self.db)
         self.feed_info = FeedInfoStore(self.db)
-        self.feeds = FeedStore(storage_fn)
+        self.feeds = FeedStore(storage_fn, cache_fn)
         self.id: str = self.key_store.get_or_create("self.repo").public_key
         self.docs: Dict[str, DocBackend] = {}
         self.actors: Dict[str, Actor] = {}
@@ -204,37 +210,104 @@ class RepoBackend:
             if actor is not None:
                 self._sync_changes(actor)
 
-    def load_documents_bulk(self, doc_ids: List[str]) -> None:
-        """Cold-start many docs in ONE device dispatch: gather each doc's
-        feed changes, pack columnar, run the batched kernel, seed each
-        DocBackend's OpSet from the replayed history. The per-doc OpSet
-        still replays host-side for the interactive path, but readiness /
-        snapshot patches come straight from the device decode."""
-        from ..ops.materialize import materialize_batch, decode_patch
+    def load_documents_bulk(
+        self, doc_ids: List[str], slab: Optional[int] = None
+    ) -> None:
+        """Cold-start many docs with zero per-op host work (the north
+        star, BASELINE config 4): each doc's feed windows come from the
+        columnar sidecars (storage/colcache.py), pack vectorized
+        (ops/columnar.py pack_docs_columns), and materialize in slab-sized
+        device dispatches. Docs come up ready with device-served clocks
+        and lazily-decoded snapshot patches; the host OpSet reconstructs
+        only when a doc takes its first incremental change
+        (DocBackend.init_deferred). Contrast the reference's per-doc
+        loadDocument replay loop (src/RepoBackend.ts:238-257)."""
+        from ..ops.columnar import pack_docs_columns
+        from ..ops.crdt_kernels import run_batch
+        from ..ops.materialize import DecodedBatch, decode_patch
 
-        histories: List[List[Change]] = []
-        with_docs: List[DocBackend] = []
-        for doc_id in doc_ids:
-            with self._lock:
-                if doc_id in self.docs:
-                    continue
-                doc = DocBackend(doc_id, self._doc_notify, None)
-                self.docs[doc_id] = doc
-            self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
+        if slab is None:
+            slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
+
+        entries = []  # (doc, spec, clock, n_changes, actor_ids)
+        with self.db.bulk():  # one commit for thousands of upserts
+            for doc_id in doc_ids:
+                with self._lock:
+                    if doc_id in self.docs:
+                        continue
+                    doc = DocBackend(doc_id, self._doc_notify, None)
+                    self.docs[doc_id] = doc
+                self.cursors.add_actor(
+                    self.id, doc_id, root_actor_id(doc_id)
+                )
+                cursor = self.cursors.get(self.id, doc_id)
+                spec = []
+                clock: Dict[str, int] = {}
+                n_changes = 0
+                for actor_id, max_seq in cursor.items():
+                    actor = self._get_or_create_actor(actor_id)
+                    fc = actor.columns()
+                    spec.append((fc, 0, max_seq))
+                    applied = fc.changes_in_window(0, max_seq)
+                    n_changes += applied
+                    if applied > 0:
+                        clock[actor_id] = applied  # seqs contiguous 1..n
+                entries.append(
+                    (doc, spec, clock, n_changes, list(cursor))
+                )
+
+        ready_ids: List[str] = []
+        with self.db.bulk():
+            self._load_slabs(
+                entries, slab, pack_docs_columns, run_batch, DecodedBatch,
+                decode_patch, ready_ids,
+            )
+        if ready_ids:
+            self.to_frontend.push(msgs.bulk_ready_msg(ready_ids))
+
+    def _load_slabs(
+        self, entries, slab, pack_docs_columns, run_batch, DecodedBatch,
+        decode_patch, ready_ids,
+    ) -> None:
+        for base in range(0, len(entries), slab):
+            chunk = entries[base : base + slab]
+            batch = pack_docs_columns([e[1] for e in chunk])
+            dec = DecodedBatch(batch, run_batch(batch))
+            for j, (doc, _spec, clock, n_changes, actor_ids) in enumerate(
+                chunk
+            ):
+                writable = None
+                for actor_id in actor_ids:
+                    a = self.actors.get(actor_id)
+                    if a is not None and a.writable:
+                        writable = actor_id
+                        break
+                doc.init_deferred(
+                    loader=self._bulk_history_loader(doc.id),
+                    clock=clock,
+                    history_len=n_changes,
+                    actor_id=writable,
+                    snapshot_fn=(
+                        lambda dec=dec, j=j: decode_patch(dec.doc_view(j), 0)
+                    ),
+                )
+                self.clocks.update(self.id, doc.id, clock)
+                ready_ids.append(doc.id)
+
+    def _bulk_history_loader(self, doc_id: str):
+        """Deferred host replay for a bulk-loaded doc: decode the feed
+        windows into Change objects only when the doc's first incremental
+        change forces an OpSet to exist."""
+
+        def load() -> List[Change]:
             cursor = self.cursors.get(self.id, doc_id)
             changes: List[Change] = []
             for actor_id, max_seq in cursor.items():
                 actor = self._get_or_create_actor(actor_id)
                 changes.extend(actor.changes_in_window(0, max_seq))
-            histories.append(changes)
-            with_docs.append(doc)
-        if not histories:
-            return
-        dec = materialize_batch(histories)
-        for i, doc in enumerate(with_docs):
-            writable = self._writable_actor_for(doc.id)
-            doc.device_snapshot = decode_patch(dec, i)  # cached for Ready
-            doc.init(histories[i], writable)
+            return changes
+
+        return load
 
     def _writable_actor_for(self, doc_id: str) -> str:
         cursor = self.cursors.get(self.id, doc_id)
@@ -290,7 +363,7 @@ class RepoBackend:
         src/RepoBackend.ts:506-531)."""
         for doc_id in self.cursors.docs_with_actor(self.id, actor.id):
             doc = self.docs.get(doc_id)
-            if doc is None or doc.opset is None:
+            if doc is None or not doc.can_apply:
                 continue
             start = doc.clock.get(actor.id, 0)
             end = self.cursors.entry(self.id, doc_id, actor.id)
@@ -335,9 +408,7 @@ class RepoBackend:
             )
 
     def _send_ready(self, doc: DocBackend) -> None:
-        snapshot = getattr(doc, "device_snapshot", None)
-        patch = snapshot if snapshot is not None else doc.snapshot_patch()
-        doc.device_snapshot = None
+        patch = doc.snapshot_patch()
         self.clocks.update(self.id, doc.id, doc.clock)
         self.to_frontend.push(
             msgs.ready_msg(
@@ -373,12 +444,12 @@ class RepoBackend:
         t = query["type"]
         if t == "Materialize":
             doc = self.docs.get(query["id"])
-            if doc is None or doc.opset is None:
-                payload = None
-            else:
-                sub = OpSet()
-                sub.apply_changes(doc.opset.history[: query["history"]])
-                payload = sub.snapshot_patch().to_json()
+            patch = (
+                doc.history_patch(query["history"])
+                if doc is not None
+                else None
+            )
+            payload = patch.to_json() if patch is not None else None
             self.to_frontend.push(msgs.reply_msg(query_id, payload))
         elif t == "Metadata":
             doc = self.docs.get(query["id"])

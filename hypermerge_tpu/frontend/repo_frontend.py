@@ -219,6 +219,12 @@ class RepoFrontend:
             from ..files.file_client import FileServerClient
 
             self.files = FileServerClient(msg["path"])
+        elif t == "BulkReady":
+            # bulk cold start: docs are ready backend-side; any already-
+            # open frontends re-request their Ready (with snapshot patch)
+            for doc_id in msg["ids"]:
+                if doc_id in self.docs:
+                    self.to_backend.push(msgs.open_msg(doc_id))
         else:
             log("repo:front", "unknown msg", t)
 

@@ -124,6 +124,14 @@ def file_server_ready_msg(path: str) -> Dict[str, Any]:
     return {"type": "FileServerReady", "path": path}
 
 
+def bulk_ready_msg(doc_ids: List[str]) -> Dict[str, Any]:
+    """Bulk cold start finished: these docs are ready backend-side; a
+    frontend opening one receives its Ready (with snapshot patch) then.
+    Keeping the per-doc patch out of this message is the point — 10k
+    snapshot decodes must not happen eagerly."""
+    return {"type": "BulkReady", "ids": list(doc_ids)}
+
+
 # ---------------------------------------------------------------------------
 # connection handshake (reference src/NetworkMsg.ts)
 

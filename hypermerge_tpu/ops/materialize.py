@@ -71,6 +71,38 @@ class DecodedBatch:
             if s > 0
         }
 
+    def doc_view(self, d: int) -> "DocView":
+        """A one-doc view whose lanes transfer individually — opening a
+        single doc out of a bulk batch must not pay for the whole [D, N]
+        lane set (decode_patch accepts this in place of the batch)."""
+        lanes = {}
+        for name in DecodedBatch._LANES:
+            if name in self.__dict__:
+                lanes[name] = self.__dict__[name][d : d + 1]
+            else:
+                lanes[name] = np.asarray(getattr(self._out, name)[d])[
+                    None
+                ]
+        cols = {k: v[d : d + 1] for k, v in self.cols.items()}
+        return DocView(self.batch, cols, lanes)
+
+
+class DocView:
+    """One document's rows/lanes, shaped [1, N] — decode_patch(view, 0)."""
+
+    def __init__(self, batch, cols, lanes) -> None:
+        self.batch = batch
+        self.cols = cols
+        for name, arr in lanes.items():
+            setattr(self, name, arr)
+
+    def clock_dict(self, _d: int) -> Dict[str, int]:
+        return {
+            self.batch.actors[a]: int(s)
+            for a, s in enumerate(self.clock[0])
+            if s > 0
+        }
+
 
 def materialize_batch(
     docs_changes, n_rows: Optional[int] = None

@@ -1,0 +1,591 @@
+"""Columnar feed cache — the vectorized cold-start sidecar.
+
+The reference cold start replays every change through the CRDT backend
+one block at a time (reference src/RepoBackend.ts:238-257 loadDocument →
+Backend.applyChanges). The TPU-first equivalent wants feeds to arrive on
+device as int32 columns with zero per-op Python. This module maintains,
+next to each feed's block log, a derived columnar encoding of the same
+ops that can be loaded with a single `np.fromfile` and sliced/remapped
+with numpy only (ops/columnar.py `pack_docs_columns`).
+
+The cache is *derived data*: the JSON change blocks in the feed remain
+the source of truth (and the replication wire format). A missing or
+stale cache is rebuilt from blocks; a torn tail (crash mid-append) is
+truncated to the last committed change, mirroring the torn-tail healing
+of FileFeedStorage (storage/feed.py).
+
+Row layout (int32 x ROW_FIELDS per op):
+  0 action   Action code
+  1 ctr      lamport counter (op id = (ctr, writer))
+  2 seq      change seq (nondecreasing -> np.searchsorted windows)
+  3 start_op ctr of the change's first op (causal sort key)
+  4 obj_ctr  container op id ctr        (0 if root)
+  5 obj_a    feed-local actor idx of container (-1 = ROOT map)
+  6 key      feed-local key-string idx (-1 = none / list op)
+  7 ref_ctr  referenced element / INC target ctr
+  8 ref_a    feed-local actor idx (-2 = HEAD, -3 = none)
+  9 insert   1 if the op creates a list/text element
+ 10 vkind    value kind (ops/columnar.py VK_*)
+ 11 value    inline int / feed-local table idx
+ 12 dt       datatype: 0 none, 1 counter, 2 timestamp
+ 13 flags    reserved
+
+Pred (supersession) edges are separate records (int32 x 3):
+  src op index (absolute, within this feed), tgt_ctr, tgt_a.
+INC ops contribute no pred edges — their target rides ref_* (matching
+ops/columnar.py _pack_one).
+
+Tables are append-only JSON lines: {"t": "a"|"k"|"s"|"f"|"b", "v": ...}
+("a" actors — index 0 is always the feed writer; "k" key strings;
+"s" value strings; "f" floats; "b" bigints as decimal strings).
+
+A commit record (int32 x 4: n_rows, n_preds, n_table_lines, flag) is
+appended **after** each change's data; load() honors only the last
+complete commit, so a torn append never corrupts the cache. flag=1
+marks a corrupt feed block (occupies a seq slot, contributes no ops) —
+needed because the host OpSet stalls an actor's changes at the first
+corrupt block (seq continuity), so `ok_prefix_len` clamps windows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..crdt.change import HEAD, ROOT, Action, Change
+
+ROW_FIELDS = 14
+PRED_FIELDS = 3
+COMMIT_FIELDS = 4
+
+# value kinds — must match ops/columnar.py
+VK_NONE = 0
+VK_INT = 1
+VK_FLOAT = 2
+VK_STR = 3
+VK_BOOL = 4
+VK_BIGINT = 5
+
+_INT32_MIN, _INT32_MAX = -(2**31), 2**31 - 1
+
+OBJ_ROOT = -1
+REF_HEAD = -2
+REF_NONE = -3
+
+
+@dataclass
+class FeedColumns:
+    """One feed's ops as numpy columns + feed-local tables.
+
+    `rows` is [n_ops, ROW_FIELDS] int32; `preds` is [n_preds, 3] int32.
+    `seq` (= rows[:, 2]) is nondecreasing, so change windows slice via
+    np.searchsorted. `ok_prefix_len` is the number of leading non-corrupt
+    changes — the host OpSet can never apply past the first corrupt block
+    of an actor, so bulk windows clamp to it.
+    """
+
+    rows: np.ndarray
+    preds: np.ndarray
+    actors: List[str]
+    keys: List[str]
+    strings: List[str]
+    floats: List[float]
+    bigints: List[int]
+    n_changes: int
+    ok_prefix_len: int
+    # per-change cumulative row counts, len n_changes+1: change i (seq
+    # i+1) owns rows [row_ends[i], row_ends[i+1])
+    row_ends: np.ndarray
+
+    @property
+    def seq(self) -> np.ndarray:
+        return self.rows[:, 2]
+
+    def window(self, start_seq: int, end_seq: float) -> Tuple[int, int]:
+        """Row range [lo, hi) for changes with seq in (start_seq, end_seq],
+        clamped to the applicable (ok) prefix."""
+        e = min(float(end_seq), float(self.ok_prefix_len))
+        e = int(e)
+        s = min(start_seq, self.n_changes)
+        lo = int(self.row_ends[s])
+        hi = int(self.row_ends[min(e, self.n_changes)]) if e > 0 else 0
+        return lo, max(hi, lo)
+
+    def changes_in_window(self, start_seq: int, end_seq: float) -> int:
+        """Number of applicable changes with seq in (start_seq, end_seq]."""
+        e = int(min(float(end_seq), float(self.ok_prefix_len)))
+        return max(0, e - min(start_seq, e))
+
+
+# ---------------------------------------------------------------------------
+# storage backends
+
+
+class MemoryColumnStorage:
+    def __init__(self) -> None:
+        self.rows: List[np.ndarray] = []
+        self.preds: List[np.ndarray] = []
+        self.tables: List[str] = []
+        self.commits: List[Tuple[int, int, int, int]] = []
+
+    def commit_change(
+        self,
+        rows: np.ndarray,
+        preds: np.ndarray,
+        table_lines: List[str],
+        flag: int,
+    ) -> None:
+        if len(rows):
+            self.rows.append(rows)
+        if len(preds):
+            self.preds.append(preds)
+        self.tables.extend(table_lines)
+        n_rows = sum(len(r) for r in self.rows)
+        n_preds = sum(len(p) for p in self.preds)
+        self.commits.append((n_rows, n_preds, len(self.tables), flag))
+
+    def load(self):
+        rows = (
+            np.concatenate(self.rows, axis=0)
+            if self.rows
+            else np.zeros((0, ROW_FIELDS), np.int32)
+        )
+        preds = (
+            np.concatenate(self.preds, axis=0)
+            if self.preds
+            else np.zeros((0, PRED_FIELDS), np.int32)
+        )
+        commits = np.asarray(self.commits, np.int32).reshape(
+            -1, COMMIT_FIELDS
+        )
+        return rows, preds, list(self.tables), commits
+
+    def close(self) -> None:
+        pass
+
+
+class FileColumnStorage:
+    """rows.bin / preds.bin / tables.jsonl / commits.bin in a directory.
+
+    Only the prefix covered by the last complete commit record is ever
+    read back — a crash mid-append loses at most the uncommitted change,
+    which the rebuild path re-derives from the feed's blocks."""
+
+    _COMMIT = struct.Struct("<4i")
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._dir_ready = os.path.isdir(path)
+        self._fhs = None  # (rows, preds, tables, commits) — lazy: a
+        # read-only bulk load over many feeds must not hold 4 FDs each
+        self._n_rows: Optional[int] = None
+        self._n_preds: Optional[int] = None
+        self._n_tables_written: Optional[int] = None
+
+    def _ensure_writable(self):
+        if self._fhs is not None:
+            return self._fhs
+        if not self._dir_ready:
+            os.makedirs(self.path, exist_ok=True)
+            self._dir_ready = True
+        self._truncate_to_committed()
+        self._fhs = (
+            open(os.path.join(self.path, "rows.bin"), "ab"),
+            open(os.path.join(self.path, "preds.bin"), "ab"),
+            open(os.path.join(self.path, "tables.jsonl"), "ab"),
+            open(os.path.join(self.path, "commits.bin"), "ab"),
+        )
+        self._n_rows = os.path.getsize(
+            os.path.join(self.path, "rows.bin")
+        ) // (4 * ROW_FIELDS)
+        self._n_preds = os.path.getsize(
+            os.path.join(self.path, "preds.bin")
+        ) // (4 * PRED_FIELDS)
+        self._n_tables_written = self._count_table_lines()
+        return self._fhs
+
+    def _truncate_to_committed(self) -> None:
+        """Drop any torn tail from a crash mid-append: the data files are
+        rolled back to the sizes the last complete commit record names
+        (the lost change re-derives from its feed block on catch-up)."""
+        cpath = os.path.join(self.path, "commits.bin")
+        csize = (
+            os.path.getsize(cpath) if os.path.exists(cpath) else 0
+        )
+        n_commits = csize // self._COMMIT.size
+        if csize != n_commits * self._COMMIT.size:
+            with open(cpath, "r+b") as fh:
+                fh.truncate(n_commits * self._COMMIT.size)
+        if n_commits:
+            with open(cpath, "rb") as fh:
+                fh.seek((n_commits - 1) * self._COMMIT.size)
+                n_rows, n_preds, n_tables, _ = self._COMMIT.unpack(
+                    fh.read(self._COMMIT.size)
+                )
+        else:
+            n_rows = n_preds = n_tables = 0
+        for name, want in (
+            ("rows.bin", n_rows * 4 * ROW_FIELDS),
+            ("preds.bin", n_preds * 4 * PRED_FIELDS),
+        ):
+            p = os.path.join(self.path, name)
+            if os.path.exists(p) and os.path.getsize(p) > want:
+                with open(p, "r+b") as fh:
+                    fh.truncate(want)
+        tp = os.path.join(self.path, "tables.jsonl")
+        if os.path.exists(tp):
+            keep = 0
+            count = 0
+            with open(tp, "rb") as fh:
+                for line in fh:
+                    if count >= n_tables or not line.endswith(b"\n"):
+                        break
+                    count += 1
+                    keep += len(line)
+            if os.path.getsize(tp) > keep:
+                with open(tp, "r+b") as fh:
+                    fh.truncate(keep)
+
+    def commit_change(
+        self,
+        rows: np.ndarray,
+        preds: np.ndarray,
+        table_lines: List[str],
+        flag: int,
+    ) -> None:
+        rows_fh, preds_fh, tables_fh, commits_fh = self._ensure_writable()
+        if len(rows):
+            rows_fh.write(np.ascontiguousarray(rows, np.int32).tobytes())
+            rows_fh.flush()
+            self._n_rows += len(rows)
+        if len(preds):
+            preds_fh.write(np.ascontiguousarray(preds, np.int32).tobytes())
+            preds_fh.flush()
+            self._n_preds += len(preds)
+        for line in table_lines:
+            tables_fh.write(line.encode("utf-8") + b"\n")
+        if table_lines:
+            tables_fh.flush()
+            self._n_tables_written += len(table_lines)
+        commits_fh.write(
+            self._COMMIT.pack(
+                self._n_rows, self._n_preds, self._n_tables_written, flag
+            )
+        )
+        commits_fh.flush()
+
+    def _count_table_lines(self) -> int:
+        p = os.path.join(self.path, "tables.jsonl")
+        if not os.path.exists(p):
+            return 0
+        with open(p, "rb") as fh:
+            return sum(1 for _ in fh)
+
+    def load(self):
+        commits_raw = self._read(os.path.join(self.path, "commits.bin"))
+        n_complete = len(commits_raw) // self._COMMIT.size
+        commits = np.frombuffer(
+            commits_raw[: n_complete * self._COMMIT.size], np.int32
+        ).reshape(-1, COMMIT_FIELDS)
+        n_rows = int(commits[-1, 0]) if n_complete else 0
+        n_preds = int(commits[-1, 1]) if n_complete else 0
+        n_tables = int(commits[-1, 2]) if n_complete else 0
+        rows_raw = self._read(os.path.join(self.path, "rows.bin"))
+        rows = np.frombuffer(
+            rows_raw[: n_rows * 4 * ROW_FIELDS], np.int32
+        ).reshape(-1, ROW_FIELDS)
+        preds_raw = self._read(os.path.join(self.path, "preds.bin"))
+        preds = np.frombuffer(
+            preds_raw[: n_preds * 4 * PRED_FIELDS], np.int32
+        ).reshape(-1, PRED_FIELDS)
+        tables: List[str] = []
+        tp = os.path.join(self.path, "tables.jsonl")
+        if os.path.exists(tp) and n_tables:
+            with open(tp, "rb") as fh:
+                for line in fh:
+                    tables.append(line.decode("utf-8").rstrip("\n"))
+                    if len(tables) >= n_tables:
+                        break
+        return rows, preds, tables, commits
+
+    @staticmethod
+    def _read(path: str) -> bytes:
+        if not os.path.exists(path):
+            return b""
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def close(self) -> None:
+        if self._fhs is not None:
+            for fh in self._fhs:
+                fh.close()
+            self._fhs = None
+
+
+def memory_column_storage_fn(_name: str) -> MemoryColumnStorage:
+    return MemoryColumnStorage()
+
+
+def file_column_storage_fn(root: str):
+    def fn(name: str) -> FileColumnStorage:
+        return FileColumnStorage(os.path.join(root, name[:2], name + ".cols"))
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# the cache
+
+
+class _Interner:
+    def __init__(self) -> None:
+        self.items: List[Any] = []
+        self._index: Dict[Any, int] = {}
+
+    def add(self, item: Any) -> int:
+        idx = self._index.get(item)
+        if idx is None:
+            idx = len(self.items)
+            self.items.append(item)
+            self._index[item] = idx
+        return idx
+
+    def __contains__(self, item: Any) -> bool:
+        return item in self._index
+
+
+class FeedColumnCache:
+    """Maintains the columnar encoding of one feed.
+
+    Writers call `append_change` after every block append (Actor does
+    this for both local writes and decoded remote blocks); bulk loaders
+    call `columns()` — a cheap incremental concatenation after the first
+    load. The encode mirrors ops/columnar.py `_pack_one` semantics:
+    INC rides ref_* with no pred edges; ops are dropped at *pack* time
+    (not here) when their obj/ref targets are absent from the packed
+    window."""
+
+    def __init__(self, storage, writer: str) -> None:
+        self._storage = storage
+        self._lock = threading.RLock()
+        self._actors = _Interner()
+        self._keys = _Interner()
+        self._strings = _Interner()
+        self._floats = _Interner()
+        self._bigints = _Interner()
+        self._pending_tables: List[str] = []
+        self.writer = writer
+
+        rows, preds, tables, commits = storage.load()
+        self._apply_tables(tables)
+        if self.writer not in self._actors:
+            # fresh cache: actor 0 is the writer (the table line flushes
+            # with the first commit)
+            self._intern("a", self._actors, writer)
+        self._row_chunks: List[np.ndarray] = [rows] if len(rows) else []
+        self._pred_chunks: List[np.ndarray] = [preds] if len(preds) else []
+        self._n_rows_total = len(rows)
+        self._n_preds_total = len(preds)
+        self._commits_arr: np.ndarray = np.asarray(
+            commits, np.int32
+        ).reshape(-1, COMMIT_FIELDS)
+        self._commits_new: List[Tuple[int, int, int, int]] = []
+        self._cached: Optional[FeedColumns] = None
+
+    # -- table interning ----------------------------------------------
+
+    def _apply_tables(self, lines: List[str]) -> None:
+        kinds = {
+            "a": self._actors,
+            "k": self._keys,
+            "s": self._strings,
+            "f": self._floats,
+            "b": self._bigints,
+        }
+        for line in lines:
+            rec = json.loads(line)
+            interner = kinds[rec["t"]]
+            v = rec["v"]
+            if rec["t"] == "b":
+                v = int(v)
+            interner.add(v)
+
+    def _intern(self, kind: str, interner: _Interner, v: Any) -> int:
+        if v in interner:
+            return interner.add(v)
+        idx = interner.add(v)
+        jv = str(v) if kind == "b" else v
+        self._pending_tables.append(
+            json.dumps({"t": kind, "v": jv}, separators=(",", ":"))
+        )
+        return idx
+
+    # -- encode --------------------------------------------------------
+
+    @property
+    def n_changes(self) -> int:
+        with self._lock:
+            return len(self._commits_arr) + len(self._commits_new)
+
+    def append_change(self, change: Optional[Change]) -> None:
+        """Encode one change (None = corrupt block placeholder)."""
+        with self._lock:
+            if change is None:
+                self._storage.commit_change(
+                    np.zeros((0, ROW_FIELDS), np.int32),
+                    np.zeros((0, PRED_FIELDS), np.int32),
+                    self._take_pending(),
+                    1,
+                )
+                self._commits_new.append(
+                    (self._total_rows(), self._total_preds(), 0, 1)
+                )
+                self._cached = None
+                return
+            rows, preds = self._encode(change)
+            lines = self._take_pending()
+            self._storage.commit_change(rows, preds, lines, 0)
+            if len(rows):
+                self._row_chunks.append(rows)
+                self._n_rows_total += len(rows)
+            if len(preds):
+                self._pred_chunks.append(preds)
+                self._n_preds_total += len(preds)
+            self._commits_new.append(
+                (self._total_rows(), self._total_preds(), 0, 0)
+            )
+            self._cached = None
+
+    def _take_pending(self) -> List[str]:
+        lines = self._pending_tables
+        self._pending_tables = []
+        return lines
+
+    def _total_rows(self) -> int:
+        return self._n_rows_total
+
+    def _total_preds(self) -> int:
+        return self._n_preds_total
+
+    def _encode(self, change: Change) -> Tuple[np.ndarray, np.ndarray]:
+        base = self._total_rows()
+        out_rows: List[List[int]] = []
+        out_preds: List[Tuple[int, int, int]] = []
+        aid = lambda actor: self._intern("a", self._actors, actor)  # noqa: E731
+        for i, op in enumerate(change.ops):
+            ctr = change.start_op + i
+            if op.obj == ROOT:
+                obj_ctr, obj_a = 0, OBJ_ROOT
+            else:
+                obj_ctr, obj_a = op.obj.ctr, aid(op.obj.actor)
+            if op.action == Action.INC:
+                if not op.pred:
+                    continue  # no target: dropped (matches _pack_one)
+                tgt = op.pred[0]
+                ref_ctr, ref_a = tgt.ctr, aid(tgt.actor)
+            elif op.ref is None:
+                ref_ctr, ref_a = 0, REF_NONE
+            elif op.ref == HEAD:
+                ref_ctr, ref_a = 0, REF_HEAD
+            else:
+                ref_ctr, ref_a = op.ref.ctr, aid(op.ref.actor)
+            vkind, value = self._encode_value(op)
+            key = (
+                self._intern("k", self._keys, op.key)
+                if op.key is not None
+                else -1
+            )
+            dt = (
+                1 if op.datatype == "counter"
+                else 2 if op.datatype == "timestamp" else 0
+            )
+            row_idx = base + len(out_rows)
+            if op.action != Action.INC:
+                for p in op.pred:
+                    out_preds.append((row_idx, p.ctr, aid(p.actor)))
+            out_rows.append(
+                [
+                    int(op.action), ctr, change.seq, change.start_op,
+                    obj_ctr, obj_a, key, ref_ctr, ref_a,
+                    1 if op.insert else 0, vkind, value, dt, 0,
+                ]
+            )
+        rows = np.asarray(out_rows, np.int32).reshape(-1, ROW_FIELDS)
+        preds = np.asarray(out_preds, np.int32).reshape(-1, PRED_FIELDS)
+        return rows, preds
+
+    def _encode_value(self, op) -> Tuple[int, int]:
+        # mirrors ops/columnar.py _encode_value
+        v = op.value
+        if op.action.makes_object or v is None:
+            return VK_NONE, 0
+        if isinstance(v, bool):
+            return VK_BOOL, 1 if v else 0
+        if isinstance(v, int):
+            if _INT32_MIN <= v <= _INT32_MAX:
+                return VK_INT, v
+            return VK_BIGINT, self._intern("b", self._bigints, v)
+        if isinstance(v, float):
+            return VK_FLOAT, self._intern("f", self._floats, v)
+        if isinstance(v, str):
+            return VK_STR, self._intern("s", self._strings, v)
+        return VK_STR, self._intern("s", self._strings, repr(v))
+
+    # -- decode --------------------------------------------------------
+
+    def columns(self) -> FeedColumns:
+        with self._lock:
+            if self._cached is not None:
+                return self._cached
+            rows = (
+                np.concatenate(self._row_chunks, axis=0)
+                if self._row_chunks
+                else np.zeros((0, ROW_FIELDS), np.int32)
+            )
+            preds = (
+                np.concatenate(self._pred_chunks, axis=0)
+                if self._pred_chunks
+                else np.zeros((0, PRED_FIELDS), np.int32)
+            )
+            self._row_chunks = [rows] if len(rows) else []
+            self._pred_chunks = [preds] if len(preds) else []
+            if self._commits_new:
+                self._commits_arr = np.concatenate(
+                    [
+                        self._commits_arr,
+                        np.asarray(self._commits_new, np.int32).reshape(
+                            -1, COMMIT_FIELDS
+                        ),
+                    ],
+                    axis=0,
+                )
+                self._commits_new = []
+            commits = self._commits_arr
+            n = len(commits)
+            bad = np.nonzero(commits[:, 3] != 0)[0]
+            ok_prefix = int(bad[0]) if len(bad) else n
+            row_ends = np.zeros(n + 1, np.int64)
+            if n:
+                row_ends[1:] = commits[:, 0]
+            self._cached = FeedColumns(
+                rows=rows,
+                preds=preds,
+                actors=list(self._actors.items),
+                keys=list(self._keys.items),
+                strings=list(self._strings.items),
+                floats=list(self._floats.items),
+                bigints=list(self._bigints.items),
+                n_changes=n,
+                ok_prefix_len=ok_prefix,
+                row_ends=row_ends,
+            )
+            return self._cached
+
+    def close(self) -> None:
+        self._storage.close()

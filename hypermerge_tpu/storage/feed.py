@@ -57,44 +57,60 @@ class FileFeedStorage:
 
     def __init__(self, path: str) -> None:
         self.path = path
-        os.makedirs(os.path.dirname(path), exist_ok=True)
         self._offsets: List[int] = []
         self._sizes: List[int] = []
-        self._fh = open(path, "ab+")
-        self._scan()
+        self._end = 0
+        # scan is lazy and no FD is held: a bulk cold start touches tens
+        # of thousands of feeds (past any ulimit), and when the columnar
+        # sidecar is fresh the block log is never read at all — only its
+        # block *count*, which the lazy scan provides on first use
+        self._scanned = not os.path.exists(path)
+        if self._scanned:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
 
-    def _scan(self) -> None:
-        self._fh.seek(0, os.SEEK_END)
-        end = self._fh.tell()
+    def _ensure_scan(self) -> None:
+        if self._scanned:
+            return
+        self._scanned = True
+        with open(self.path, "rb") as fh:
+            raw = fh.read()
+        end = len(raw)
         pos = 0
-        self._fh.seek(0)
         while pos + self._HDR.size <= end:
-            (size,) = self._HDR.unpack(self._fh.read(self._HDR.size))
+            (size,) = self._HDR.unpack_from(raw, pos)
             if pos + self._HDR.size + size > end:
                 break  # torn tail: ignore
             self._offsets.append(pos + self._HDR.size)
             self._sizes.append(size)
             pos += self._HDR.size + size
-            self._fh.seek(pos)
+        self._end = pos
 
     def append(self, data: bytes) -> None:
-        self._fh.seek(0, os.SEEK_END)
-        pos = self._fh.tell()
-        self._fh.write(self._HDR.pack(len(data)))
-        self._fh.write(data)
-        self._fh.flush()
-        self._offsets.append(pos + self._HDR.size)
+        self._ensure_scan()
+        mode = "r+b" if os.path.exists(self.path) else "w+b"
+        with open(self.path, mode) as fh:
+            fh.seek(self._end)  # overwrite any torn tail...
+            fh.write(self._HDR.pack(len(data)))
+            fh.write(data)
+            fh.truncate()  # ...and drop stale bytes beyond it, so a later
+            # scan can't misparse leftovers as a phantom block
+            fh.flush()
+        self._offsets.append(self._end + self._HDR.size)
         self._sizes.append(len(data))
+        self._end += self._HDR.size + len(data)
 
     def get(self, index: int) -> bytes:
-        self._fh.seek(self._offsets[index])
-        return self._fh.read(self._sizes[index])
+        self._ensure_scan()
+        with open(self.path, "rb") as fh:
+            fh.seek(self._offsets[index])
+            return fh.read(self._sizes[index])
 
     def __len__(self) -> int:
+        self._ensure_scan()
         return len(self._offsets)
 
     def close(self) -> None:
-        self._fh.close()
+        pass
 
 
 StorageFn = Callable[[str], object]  # name -> storage backend
@@ -126,6 +142,9 @@ class Feed:
         self._storage = storage
         self._lock = threading.RLock()
         self._append_listeners: List[Callable[[int, bytes], None]] = []
+        # columnar sidecar (storage/colcache.py), attached by FeedStore
+        # when a cache_fn is configured; maintained by Actor
+        self.colcache = None
 
     @property
     def writable(self) -> bool:
@@ -169,6 +188,8 @@ class Feed:
             self._append_listeners.append(cb)
 
     def close(self) -> None:
+        if self.colcache is not None:
+            self.colcache.close()
         self._storage.close()
 
 
@@ -179,8 +200,13 @@ class FeedStore:
     stream, reference src/FeedStore.ts:26-142) minus streams — readers
     subscribe to appends instead."""
 
-    def __init__(self, storage_fn: StorageFn) -> None:
+    def __init__(
+        self,
+        storage_fn: StorageFn,
+        cache_fn: Optional[StorageFn] = None,
+    ) -> None:
         self._storage_fn = storage_fn
+        self._cache_fn = cache_fn
         self._feeds: Dict[str, Feed] = {}
         self._by_discovery: Dict[str, str] = {}
         self._lock = threading.RLock()
@@ -199,6 +225,12 @@ class FeedStore:
                 feed = Feed(
                     public_key, self._storage_fn(public_key), secret_key
                 )
+                if self._cache_fn is not None:
+                    from .colcache import FeedColumnCache
+
+                    feed.colcache = FeedColumnCache(
+                        self._cache_fn(public_key), writer=public_key
+                    )
                 self._feeds[public_key] = feed
                 self._by_discovery[feed.discovery_id] = public_key
                 self.feed_q.push(feed)

@@ -9,6 +9,7 @@ API without touching callers.
 
 from __future__ import annotations
 
+import contextlib
 import sqlite3
 import threading
 
@@ -47,20 +48,38 @@ class SqlDatabase:
         self.path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
+        self._defer_commit = 0
         with self._lock:
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
 
+    @contextlib.contextmanager
+    def bulk(self):
+        """Defer commits for a batch of writes (bulk cold start issues
+        thousands of per-feed/per-doc upserts; one fsync, not N). Holds
+        the db lock for the duration so writes from other threads can't
+        slip into the deferred window and silently lose durability."""
+        with self._lock:
+            self._defer_commit += 1
+            try:
+                yield self
+            finally:
+                self._defer_commit -= 1
+                if self._defer_commit == 0:
+                    self._conn.commit()
+
     def execute(self, sql: str, params=()) -> sqlite3.Cursor:
         with self._lock:
             cur = self._conn.execute(sql, params)
-            self._conn.commit()
+            if not self._defer_commit:
+                self._conn.commit()
             return cur
 
     def executemany(self, sql: str, rows) -> None:
         with self._lock:
             self._conn.executemany(sql, rows)
-            self._conn.commit()
+            if not self._defer_commit:
+                self._conn.commit()
 
     def query(self, sql: str, params=()) -> list:
         with self._lock:
