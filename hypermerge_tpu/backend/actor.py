@@ -119,10 +119,21 @@ class Actor:
     def _sync_cache_locked(self) -> None:
         """Bring the columnar sidecar up to the feed head (decodes only
         the blocks the cache is missing — a fresh cache over an existing
-        feed rebuilds here)."""
+        feed rebuilds here). A sidecar AHEAD of the feed (feed file
+        replaced or torn-tail-truncated after the sidecar committed) is
+        never trusted: blocks are the source of truth, so the cache is
+        discarded and rebuilt from them."""
         cc = self._colcache
         n = cc.n_changes
         head = len(self.changes)
+        if n > head:
+            log(
+                "repo:actor",
+                f"colcache ahead of feed {self.id[:6]} "
+                f"({n} > {head}): rebuilding from blocks",
+            )
+            cc.reset()
+            n = 0
         for i in range(n, head):
             cc.append_change(self._get_change(i))
 

@@ -39,6 +39,7 @@ class DocBackend:
         self._lazy_clock: Optional[clockmod.Clock] = None
         self._lazy_len = 0
         self._snapshot_fn: Optional[Callable[[], Any]] = None
+        self._snapshot_cache: Optional[Any] = None
         self.ready = Queue(f"doc:{doc_id[:6]}:ready")
         self._announced = False
         self.minimum_clock: Optional[clockmod.Clock] = None
@@ -120,6 +121,7 @@ class DocBackend:
             loader, self._lazy_loader = self._lazy_loader, None
             self._lazy_clock = None
             self._snapshot_fn = None
+            self._snapshot_cache = None
             if loader is not None:
                 with bench("doc:lazyReplay"):
                     self.opset.apply_changes(loader())
@@ -169,9 +171,19 @@ class DocBackend:
 
     def snapshot_patch(self):
         with self._lock:
-            if self.opset is None and self._snapshot_fn is not None:
-                return self._snapshot_fn()
-            return self.opset.snapshot_patch() if self.opset else None
+            if self.opset is not None:
+                return self.opset.snapshot_patch()
+            if self._snapshot_cache is not None:
+                return self._snapshot_cache
+            if self._snapshot_fn is not None:
+                # Decode once and drop the closure: a bulk-load snapshot_fn
+                # pins its slab's device/host lanes, which must not outlive
+                # the first Ready it serves (the clock can't move while the
+                # doc is still lazy, so the decoded Patch stays valid).
+                fn, self._snapshot_fn = self._snapshot_fn, None
+                self._snapshot_cache = fn()
+                return self._snapshot_cache
+            return None
 
     # ------------------------------------------------------------------
 

@@ -42,13 +42,24 @@ class Metadata:
             }
 
     def add_file(self, url: str, size: int, mime_type: str) -> None:
-        """Write-through: durable first, then visible."""
+        """Write-through: durable first, then visible. Re-announcing a
+        fileId the ledger already holds with identical metadata is not
+        re-appended. (Uploads mint a fresh keypair per file, so this
+        guards direct re-announcement of a known id, not content-level
+        dedup of identical blobs.)"""
+        file_id = url_to_id(url)
         entry = {
             "type": "File",
-            "fileId": url_to_id(url),
+            "fileId": file_id,
             "bytes": size,
             "mimeType": mime_type,
         }
+        existing = self.files.get(file_id)
+        if existing is not None and (
+            existing.get("bytes") == size
+            and existing.get("mimeType") == mime_type
+        ):
+            return
         self.ledger.append(json_buffer.bufferify(entry))
         self._apply(entry)
 

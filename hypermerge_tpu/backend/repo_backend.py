@@ -230,6 +230,8 @@ class RepoBackend:
             slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
 
         entries = []  # (doc, spec, clock, n_changes, actor_ids)
+        contiguous: Dict[str, bool] = {}  # per-actor-feed verification
+        fallback_docs: List[DocBackend] = []
         with self.db.bulk():  # one commit for thousands of upserts
             for doc_id in doc_ids:
                 with self._lock:
@@ -244,14 +246,41 @@ class RepoBackend:
                 spec = []
                 clock: Dict[str, int] = {}
                 n_changes = 0
+                ok = True
                 for actor_id, max_seq in cursor.items():
                     actor = self._get_or_create_actor(actor_id)
                     fc = actor.columns()
+                    # the clock shortcut below assumes seqs 1..n; verify
+                    # once per feed and route gap-y feeds to the safe
+                    # per-doc replay path instead of mis-clocking
+                    good = contiguous.get(actor_id)
+                    if good is None:
+                        good = fc.seqs_contiguous()
+                        contiguous[actor_id] = good
+                        if not good:
+                            log(
+                                "repo:backend",
+                                f"feed {actor_id[:6]} has non-contiguous "
+                                "seqs; bulk clock shortcut unsafe",
+                            )
+                    ok = ok and good
                     spec.append((fc, 0, max_seq))
                     applied = fc.changes_in_window(0, max_seq)
                     n_changes += applied
                     if applied > 0:
                         clock[actor_id] = applied  # seqs contiguous 1..n
+                if not ok:
+                    fallback_docs.append(doc)
+                    continue
+                if n_changes == 0:
+                    # Unknown doc with no local history: same minimumClock
+                    # render gate _load_document applies — don't announce
+                    # an empty doc before the root actor's first change
+                    # replicates in.
+                    root = root_actor_id(doc_id)
+                    root_actor = self.actors.get(root)
+                    if root_actor is None or not root_actor.writable:
+                        doc.update_minimum_clock({root: 1})
                 entries.append(
                     (doc, spec, clock, n_changes, list(cursor))
                 )
@@ -262,8 +291,23 @@ class RepoBackend:
                 entries, slab, pack_docs_columns, run_batch, DecodedBatch,
                 decode_patch, ready_ids,
             )
+        for doc in fallback_docs:
+            self._load_document(doc)
         if ready_ids:
             self.to_frontend.push(msgs.bulk_ready_msg(ready_ids))
+        # Blocks replicated while the bulk load was in flight hit
+        # _sync_changes before the docs could apply; re-sync every actor
+        # now (cheap no-op when clocks already match), as _load_document
+        # does after init.
+        synced = set()
+        for _doc, _spec, _clock, _n, actor_ids in entries:
+            for actor_id in actor_ids:
+                if actor_id in synced:
+                    continue
+                synced.add(actor_id)
+                actor = self.actors.get(actor_id)
+                if actor is not None:
+                    self._sync_changes(actor)
 
     def _load_slabs(
         self, entries, slab, pack_docs_columns, run_batch, DecodedBatch,
@@ -292,7 +336,8 @@ class RepoBackend:
                     ),
                 )
                 self.clocks.update(self.id, doc.id, clock)
-                ready_ids.append(doc.id)
+                if doc._announced:  # minimum-clock-gated docs wait
+                    ready_ids.append(doc.id)
 
     def _bulk_history_loader(self, doc_id: str):
         """Deferred host replay for a bulk-loaded doc: decode the feed

@@ -45,7 +45,14 @@ class _Handler(BaseHTTPRequestHandler):
         while remaining > 0:
             chunk = self.rfile.read(min(remaining, MAX_BLOCK_SIZE))
             if not chunk:
-                break
+                # A short body means the client disconnected mid-upload.
+                # Raising here aborts FileStore.write BEFORE the trailing
+                # header block is appended, so the truncated feed is never
+                # durably recorded as a complete file (header-last
+                # completeness contract, reference src/FileStore.ts:38-67).
+                raise ConnectionError(
+                    f"client disconnected with {remaining} bytes unread"
+                )
             remaining -= len(chunk)
             yield chunk
 
@@ -53,14 +60,26 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", "0"))
         if self.path != "/":
             # drain the body so a keep-alive connection stays parseable
-            for _ in self._body_chunks(length):
-                pass
+            try:
+                for _ in self._body_chunks(length):
+                    pass
+            except ConnectionError:
+                self.close_connection = True
+                return
             self._error(404, "upload path is /")
             return
         mime = self.headers.get("Content-Type", "application/octet-stream")
         # stream straight into the chunked write path — never buffer the
         # whole upload in memory
-        header = self.store.write(self._body_chunks(length), mime)
+        try:
+            header = self.store.write(self._body_chunks(length), mime)
+        except ConnectionError as exc:
+            self.close_connection = True
+            try:
+                self._error(400, str(exc))
+            except OSError:
+                pass  # the socket is gone; nothing to tell the client
+            return
         payload = json_buffer.bufferify(header.to_json())
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -101,7 +120,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
-        self.wfile.write(body)
+        # HEAD responses carry headers only (RFC 9110 §9.3.2) — writing a
+        # body would desync a keep-alive client's framing.
+        if self.command != "HEAD":
+            self.wfile.write(body)
 
 
 class FileServer:

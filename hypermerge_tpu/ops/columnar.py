@@ -451,16 +451,19 @@ def pack_docs_columns(
         [int(luts["a"][fci][0]) for fci in range(len(fcs))], np.int64
     )
     actor_g = np.repeat(writer_g[w_fc_a], w_cnt_a)
-    obj_a_g = np.where(
-        obj_a_l >= 0, alut[aoff_col + np.maximum(obj_a_l, 0)], obj_a_l
-    )
-    ref_a_g = np.where(
-        ref_a_l >= 0, alut[aoff_col + np.maximum(ref_a_l, 0)], ref_a_l
-    )
-    key_g = np.where(
-        key_l >= 0,
-        klut[np.repeat(koffs[w_fc_a], w_cnt_a) + np.maximum(key_l, 0)],
-        -1,
+
+    def _lut_where(cond, lut, idx, alt):
+        # np.where evaluates both branches: rows where cond is False
+        # carry a sentinel local index (e.g. -1), and a feed whose table
+        # is empty but sits at the end of the flat LUT would index one
+        # past the end — clamp before gathering, select after.
+        safe = np.minimum(np.maximum(idx, 0), len(lut) - 1)
+        return np.where(cond, lut[safe], alt)
+
+    obj_a_g = _lut_where(obj_a_l >= 0, alut, aoff_col + obj_a_l, obj_a_l)
+    ref_a_g = _lut_where(ref_a_l >= 0, alut, aoff_col + ref_a_l, ref_a_l)
+    key_g = _lut_where(
+        key_l >= 0, klut, np.repeat(koffs[w_fc_a], w_cnt_a) + key_l, -1
     )
     value_g = value_l.copy()
     for code, lut, offs in (

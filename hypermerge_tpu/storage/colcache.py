@@ -122,6 +122,24 @@ class FeedColumns:
         e = int(min(float(end_seq), float(self.ok_prefix_len)))
         return max(0, e - min(start_seq, e))
 
+    def seqs_contiguous(self) -> bool:
+        """True iff the rows' seq column matches the contiguous 1..n
+        assignment (change i owns seq i+1). The bulk clock shortcut
+        (clock[actor] = applied-change count) is only sound under this
+        invariant; a feed with a seq gap — e.g. partially replicated or
+        corrupt-then-healed out-of-band — must fail loudly, not produce a
+        silently wrong clock."""
+        n = int(self.row_ends[-1]) if len(self.row_ends) else 0
+        if n != len(self.rows):
+            return False
+        expected = np.repeat(
+            np.arange(1, self.n_changes + 1, dtype=np.int64),
+            np.diff(self.row_ends),
+        )
+        return bool(
+            np.array_equal(self.seq[:n].astype(np.int64), expected)
+        )
+
 
 # ---------------------------------------------------------------------------
 # storage backends
@@ -165,6 +183,12 @@ class MemoryColumnStorage:
             -1, COMMIT_FIELDS
         )
         return rows, preds, list(self.tables), commits
+
+    def reset(self) -> None:
+        self.rows.clear()
+        self.preds.clear()
+        self.tables.clear()
+        self.commits.clear()
 
     def close(self) -> None:
         pass
@@ -320,6 +344,17 @@ class FileColumnStorage:
             return b""
         with open(path, "rb") as fh:
             return fh.read()
+
+    def reset(self) -> None:
+        """Discard all cache contents (used when the sidecar disagrees
+        with its feed — e.g. a restored/replaced feed file left the
+        sidecar ahead of the block log)."""
+        self.close()
+        for name in ("rows.bin", "preds.bin", "tables.jsonl", "commits.bin"):
+            p = os.path.join(self.path, name)
+            if os.path.exists(p):
+                os.remove(p)
+        self._n_rows = self._n_preds = self._n_tables_written = None
 
     def close(self) -> None:
         if self._fhs is not None:
@@ -538,6 +573,28 @@ class FeedColumnCache:
         return VK_STR, self._intern("s", self._strings, repr(v))
 
     # -- decode --------------------------------------------------------
+
+    def reset(self) -> None:
+        """Discard the cache and start over (storage included). Invoked
+        by Actor when the sidecar claims more changes than the feed holds
+        — blocks are the source of truth, so a cache that ran ahead (e.g.
+        feed file replaced/truncated out-of-band) must rebuild."""
+        with self._lock:
+            self._storage.reset()
+            self._actors = _Interner()
+            self._keys = _Interner()
+            self._strings = _Interner()
+            self._floats = _Interner()
+            self._bigints = _Interner()
+            self._pending_tables = []
+            self._intern("a", self._actors, self.writer)
+            self._row_chunks = []
+            self._pred_chunks = []
+            self._n_rows_total = 0
+            self._n_preds_total = 0
+            self._commits_arr = np.zeros((0, COMMIT_FIELDS), np.int32)
+            self._commits_new = []
+            self._cached = None
 
     def columns(self) -> FeedColumns:
         with self._lock:
