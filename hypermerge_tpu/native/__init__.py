@@ -1,0 +1,195 @@
+"""ctypes loader for the C++ native layer (libhm_native.so).
+
+The reference leans on four native npm addons — sodium (ed25519/blake2b),
+iltorb (brotli), better-sqlite3, utp-native (SURVEY.md §2.4). This module
+loads our C++ equivalent for the crypto + codec surface and exposes it to
+Python; every capability degrades to a pure-Python fallback at the call
+site (utils/crypto.py, storage/block.py), so the framework runs — slower
+— on machines without a toolchain or the shared libraries.
+
+The shared object builds on demand: first import runs `make` in this
+directory when `libhm_native.so` is absent and a compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import shutil
+import subprocess
+import threading
+from typing import Optional
+
+CAP_SODIUM = 1
+CAP_BROTLI = 2
+CAP_ZLIB = 4
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libhm_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        return False
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (subprocess.SubprocessError, OSError):
+        return False
+    return os.path.exists(_SO)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.hm_caps.restype = ctypes.c_int
+    lib.hm_ed25519_public.restype = ctypes.c_int
+    lib.hm_ed25519_public.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.hm_ed25519_sign.restype = ctypes.c_int
+    lib.hm_ed25519_sign.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.hm_ed25519_verify.restype = ctypes.c_int
+    lib.hm_ed25519_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.hm_blake2b.restype = ctypes.c_int
+    lib.hm_blake2b.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.hm_merkle_root.restype = ctypes.c_int
+    lib.hm_merkle_root.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.hm_compress_bound.restype = ctypes.c_size_t
+    lib.hm_compress_bound.argtypes = [ctypes.c_size_t]
+    lib.hm_compress.restype = ctypes.c_long
+    lib.hm_compress.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+        ctypes.c_char_p, ctypes.c_size_t,
+    ]
+    lib.hm_decompress.restype = ctypes.c_long
+    lib.hm_decompress.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+        ctypes.c_size_t,
+    ]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The bound library, building it first if needed; None when
+    unavailable (no compiler and no prebuilt .so)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("HM_NO_NATIVE"):
+            return None
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def caps() -> int:
+    lib = load()
+    return lib.hm_caps() if lib is not None else 0
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------
+# typed wrappers (None / raise on unavailable capability — callers that
+# want graceful degradation go through utils/crypto.py)
+
+
+def ed25519_public(seed: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    out = ctypes.create_string_buffer(32)
+    if lib.hm_ed25519_public(seed, out) != 0:
+        return None
+    return out.raw
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    sig = ctypes.create_string_buffer(64)
+    if lib.hm_ed25519_sign(seed, msg, len(msg), sig) != 0:
+        return None
+    return sig.raw
+
+
+def ed25519_verify(pub: bytes, msg: bytes, sig: bytes) -> Optional[bool]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    return bool(lib.hm_ed25519_verify(pub, msg, len(msg), sig))
+
+
+def blake2b(
+    data: bytes, key: bytes = b"", outlen: int = 32
+) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    out = ctypes.create_string_buffer(outlen)
+    if lib.hm_blake2b(data, len(data), key or None, len(key), out, outlen) != 0:
+        return None
+    return out.raw
+
+
+def merkle_root(leaves: bytes) -> Optional[bytes]:
+    """Root over concatenated 32-byte leaf hashes."""
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    if len(leaves) % 32:
+        raise ValueError("leaves must be a multiple of 32 bytes")
+    out = ctypes.create_string_buffer(32)
+    if lib.hm_merkle_root(leaves, len(leaves) // 32, out) != 0:
+        return None
+    return out.raw
+
+
+CODEC_BROTLI = 1
+CODEC_ZLIB = 2
+
+
+def compress(codec: int, data: bytes, quality: int = 5) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    cap = lib.hm_compress_bound(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.hm_compress(codec, quality, data, len(data), out, cap)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+def decompress(codec: int, data: bytes, raw_len: int) -> Optional[bytes]:
+    lib = load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(max(raw_len, 1))
+    n = lib.hm_decompress(codec, data, len(data), out, raw_len)
+    if n < 0:
+        return None
+    return out.raw[:n]

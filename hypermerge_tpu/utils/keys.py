@@ -13,7 +13,7 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
-from . import base58, ed25519
+from . import base58, crypto
 
 _DISCOVERY_CONTEXT = b"hypermerge-tpu"
 
@@ -32,7 +32,7 @@ class KeyBuffer:
 
 def create_buffer(seed: Optional[bytes] = None) -> KeyBuffer:
     seed = seed if seed is not None else os.urandom(32)
-    return KeyBuffer(public_key=ed25519.public_key(seed), secret_key=seed)
+    return KeyBuffer(public_key=crypto.public_key(seed), secret_key=seed)
 
 
 def create(seed: Optional[bytes] = None) -> KeyPair:
