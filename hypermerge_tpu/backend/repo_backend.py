@@ -313,9 +313,15 @@ class RepoBackend:
         self, entries, slab, pack_docs_columns, run_batch, DecodedBatch,
         decode_patch, ready_ids,
     ) -> None:
+        from ..ops.columnar import round_up_pow2
+
         for base in range(0, len(entries), slab):
             chunk = entries[base : base + slab]
-            batch = pack_docs_columns([e[1] for e in chunk])
+            # bucket the doc axis (pow2) so every slab of a bulk load —
+            # and every later bulk load — reuses one compiled executable
+            batch = pack_docs_columns(
+                [e[1] for e in chunk], n_docs=round_up_pow2(len(chunk))
+            )
             dec = DecodedBatch(batch, run_batch(batch))
             for j, (doc, _spec, clock, n_changes, actor_ids) in enumerate(
                 chunk

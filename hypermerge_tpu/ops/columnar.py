@@ -87,7 +87,14 @@ class _Interner:
 
 @dataclass
 class ColumnarBatch:
-    """[D, N] padded op columns + [D, P] pred edges + side tables."""
+    """[D, N] padded op columns + [D, P] pred edges + side tables.
+
+    `doc_actors` is the per-doc local actor map: [D, A_loc] int32
+    indices into `actors`, ascending (== actor-string sort order, the
+    device tie-break), padded with -1. The device kernels only ever see
+    A_loc (max actors per doc, a small constant) — never the batch-wide
+    actor count — so the jit bucket and the [D, A_loc] clock output stay
+    independent of how many documents share a slab."""
 
     cols: Dict[str, np.ndarray]
     psrc: np.ndarray
@@ -99,6 +106,7 @@ class ColumnarBatch:
     floats: List[float]
     bigints: List[int]
     op_actor_ids: List[List[str]] = field(default_factory=list)
+    doc_actors: Optional[np.ndarray] = None  # [D, A_loc] int32, -1 pad
 
     @property
     def shape(self) -> Tuple[int, int]:
@@ -176,6 +184,7 @@ def pack_docs(
     ptgt = np.full((D, P), -1, dtype=np.int32)
     n_ops = np.zeros((D,), dtype=np.int32)
 
+    doc_actor_sets: List[List[int]] = []
     for d, (doc_cols, preds) in enumerate(per_doc):
         n = len(doc_cols["action"])
         n_ops[d] = n
@@ -184,6 +193,7 @@ def pack_docs(
         for k, (s, t) in enumerate(preds):
             psrc[d, k] = s
             ptgt[d, k] = t
+        doc_actor_sets.append(sorted(set(doc_cols["actor"])))
 
     return ColumnarBatch(
         cols=cols,
@@ -195,14 +205,46 @@ def pack_docs(
         strings=list(str_ids.items),
         floats=list(float_ids.items),
         bigints=list(big_ids.items),
+        doc_actors=pack_doc_actor_map(doc_actor_sets),
     )
 
 
-def _round_up(n: int) -> int:
+def pack_doc_actor_map(doc_actor_sets: Sequence[Sequence[int]]) -> np.ndarray:
+    """[D, A_loc] int32 local actor map from per-doc ascending actor-index
+    lists; -1 pads. A_loc = max actors in any one doc (min 1)."""
+    D = len(doc_actor_sets)
+    a_loc = max((len(s) for s in doc_actor_sets), default=1)
+    out = np.full((D, max(a_loc, 1)), -1, np.int32)
+    for d, s in enumerate(doc_actor_sets):
+        out[d, : len(s)] = s
+    return out
+
+
+def round_up_pow2(n: int) -> int:
     p = 1
     while p < n:
         p <<= 1
     return p
+
+
+_round_up = round_up_pow2
+
+
+def doc_actor_map_from_pairs(
+    pairs: np.ndarray, A: int, Dp: int
+) -> np.ndarray:
+    """[Dp, A_loc] local actor map from sorted unique (doc*A + actor)
+    composites; ascending within a doc (== actor-string sort order when
+    actor indices index a sorted actor table), -1 pads."""
+    pair_doc = pairs // A
+    pair_counts = np.bincount(pair_doc, minlength=Dp).astype(np.int64)
+    A_loc = int(pair_counts.max(initial=1))
+    pair_starts = np.zeros(Dp + 1, np.int64)
+    np.cumsum(pair_counts, out=pair_starts[1:])
+    out = np.full(Dp * max(A_loc, 1), -1, np.int32)
+    slot = np.arange(len(pairs), dtype=np.int64) - pair_starts[pair_doc]
+    out[pair_doc * A_loc + slot] = (pairs % A).astype(np.int32)
+    return out.reshape(Dp, max(A_loc, 1))
 
 
 def _pack_one(
@@ -300,6 +342,7 @@ def pack_docs_columns(
     doc_specs: Sequence[Sequence[Tuple[Any, int, float]]],
     n_rows: Optional[int] = None,
     n_pred: Optional[int] = None,
+    n_docs: Optional[int] = None,
 ) -> ColumnarBatch:
     """Pack documents from columnar feed windows.
 
@@ -308,6 +351,10 @@ def pack_docs_columns(
     end_seq] like Actor.changes_in_window. Produces a ColumnarBatch
     equivalent (same device-kernel results and decoded patches) to
     `pack_docs` over the same histories.
+
+    `n_docs` pads the doc axis with empty (all-PAD) documents — slab
+    loaders bucket the batch shape so every slab reuses one compiled
+    kernel executable.
     """
     from ..storage.colcache import (
         OBJ_ROOT,
@@ -319,6 +366,7 @@ def pack_docs_columns(
     )
 
     D = len(doc_specs)
+    Dp = max(n_docs, D) if n_docs is not None else D
 
     # -- global tables + per-feed LUTs ---------------------------------
     fcs: List[Any] = []
@@ -422,7 +470,7 @@ def pack_docs_columns(
         N = n_rows if n_rows is not None else 1
         P = n_pred if n_pred is not None else 1
         return _empty_batch(
-            D, N, P, sorted_actors, key_int, str_int, float_int, big_int
+            Dp, N, P, sorted_actors, key_int, str_int, float_int, big_int
         )
 
     w_cnt_a = np.asarray(w_cnt, np.int64)
@@ -499,10 +547,10 @@ def pack_docs_columns(
             int(pr_tgt_ctr.max(initial=0)))
     )
     cb = max(1, max_ctr.bit_length())
-    db = max(1, int(D - 1).bit_length())
+    db = max(1, int(Dp - 1).bit_length())
     if db + cb + ab > 62:
         raise ValueError(
-            f"composite key overflow: docs={D} ctr={max_ctr} actors={A}"
+            f"composite key overflow: docs={Dp} ctr={max_ctr} actors={A}"
         )
 
     def _rowkey(doc, c, a):
@@ -563,7 +611,7 @@ def pack_docs_columns(
             N = n_rows if n_rows is not None else 1
             P = n_pred if n_pred is not None else 1
             return _empty_batch(
-                D, N, P, sorted_actors, key_int, str_int, float_int,
+                Dp, N, P, sorted_actors, key_int, str_int, float_int,
                 big_int,
             )
         rk = _rowkey(doc_col, ctr, actor_g)
@@ -581,8 +629,8 @@ def pack_docs_columns(
     perm = np.argsort(sort_key, kind="stable")
     inv = np.empty(M, np.int64)
     inv[perm] = np.arange(M, dtype=np.int64)
-    doc_counts = np.bincount(doc_col, minlength=D).astype(np.int64)
-    doc_starts = np.zeros(D + 1, np.int64)
+    doc_counts = np.bincount(doc_col, minlength=Dp).astype(np.int64)
+    doc_starts = np.zeros(Dp + 1, np.int64)
     np.cumsum(doc_counts, out=doc_starts[1:])
     pos = inv - doc_starts[doc_col]
 
@@ -602,14 +650,14 @@ def pack_docs_columns(
         pr_doc = pr_doc[pk]
         p_src_row = pos[pr_src[pk]]
         p_tgt_row = pos[tgt_row[pk]]
-        pred_counts = np.bincount(pr_doc, minlength=D).astype(np.int64)
-        pred_starts = np.zeros(D + 1, np.int64)
+        pred_counts = np.bincount(pr_doc, minlength=Dp).astype(np.int64)
+        pred_starts = np.zeros(Dp + 1, np.int64)
         np.cumsum(pred_counts, out=pred_starts[1:])
         # pr_doc is nondecreasing (windows gathered doc-by-doc; the
         # validity compaction preserves order)
         p_pos = np.arange(len(pr_doc), dtype=np.int64) - pred_starts[pr_doc]
     else:
-        pred_counts = np.zeros(D, np.int64)
+        pred_counts = np.zeros(Dp, np.int64)
         p_src_row = p_tgt_row = p_pos = pr_doc = np.zeros(0, np.int64)
 
     # -- scatter into padded [D, N] ------------------------------------
@@ -633,26 +681,33 @@ def pack_docs_columns(
         "vkind": vkind, "value": value_g, "dt": dt,
     }
     for name in COLUMNS:
-        flat = np.full(D * N, defaults.get(name, 0), np.int32)
+        flat = np.full(Dp * N, defaults.get(name, 0), np.int32)
         flat[flat_idx] = sources[name].astype(np.int32)
-        cols[name] = flat.reshape(D, N)
-    psrc = np.full(D * P, -1, np.int32)
-    ptgt = np.full(D * P, -1, np.int32)
+        cols[name] = flat.reshape(Dp, N)
+    psrc = np.full(Dp * P, -1, np.int32)
+    ptgt = np.full(Dp * P, -1, np.int32)
     if len(p_src_row):
         pidx = pr_doc * P + p_pos
         psrc[pidx] = p_src_row.astype(np.int32)
         ptgt[pidx] = p_tgt_row.astype(np.int32)
 
+    # per-doc local actor map (ascending == string sort order: actor_g
+    # indexes sorted_actors)
+    doc_actors = doc_actor_map_from_pairs(
+        np.unique(doc_col * np.int64(A) + actor_g), A, Dp
+    )
+
     return ColumnarBatch(
         cols=cols,
-        psrc=psrc.reshape(D, P),
-        ptgt=ptgt.reshape(D, P),
+        psrc=psrc.reshape(Dp, P),
+        ptgt=ptgt.reshape(Dp, P),
         n_ops=doc_counts.astype(np.int32),
         actors=list(sorted_actors),
         keys=list(key_int.items),
         strings=list(str_int.items),
         floats=list(float_int.items),
         bigints=list(big_int.items),
+        doc_actors=doc_actors,
     )
 
 
@@ -680,6 +735,7 @@ def _empty_batch(
         strings=list(str_int.items),
         floats=list(float_int.items),
         bigints=list(big_int.items),
+        doc_actors=np.full((D, 1), -1, np.int32),
     )
 
 

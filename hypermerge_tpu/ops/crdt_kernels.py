@@ -22,14 +22,52 @@ Everything is `vmap`ed over the leading doc axis and jit-cached per
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
 
+
 from ..crdt.change import Action
-from .columnar import PAD, ColumnarBatch
+from .columnar import (
+    PAD,
+    ColumnarBatch,
+    doc_actor_map_from_pairs,
+    round_up_pow2,
+)
+
+_cache_checked = False
+
+
+def _enable_persistent_compile_cache() -> None:
+    """Cold processes reuse warm processes' XLA executables: with stable
+    jit buckets (A_loc/K bucketing below + slab-shape padding in the bulk
+    loader) a second-process bulk load skips the ~25s kernel compile
+    entirely. HM_COMPILE_CACHE overrides the location; empty disables.
+    CPU backends are excluded: compiles there are fast and XLA:CPU AOT
+    reload warns about machine-feature mismatches."""
+    global _cache_checked
+    if _cache_checked:
+        return
+    _cache_checked = True
+    d = os.environ.get(
+        "HM_COMPILE_CACHE",
+        os.path.join(
+            os.path.expanduser("~"), ".cache", "hypermerge_tpu", "xla"
+        ),
+    )
+    if not d or jax.default_backend() == "cpu":
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # unknown flags on an older jax: feature off
+        pass
+
+
 
 _SET = int(Action.SET)
 _DEL = int(Action.DEL)
@@ -57,14 +95,25 @@ def _ceil_log2(n: int) -> int:
 
 def _doc_kernel(
     action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
-    *, A: int, K: int,
+    doc_actors, *, A: int, K: int,
 ):
+    """One document. `actor` holds batch-global actor indices; `doc_actors`
+    [A] is this doc's ascending local actor map (-1 pad). A = A_loc, the
+    per-doc actor bucket — a small constant independent of how many docs
+    (and therefore distinct actors) share the batch, so the jit cache key
+    and the [A] clock output don't scale with slab size."""
     N = action.shape[0]
     idx = jnp.arange(N, dtype=jnp.int32)
     valid = action != PAD
     is_make = (action <= 3) & valid
     is_set = (action == _SET) & valid
     is_ins = (insert == 1) & valid
+
+    # local actor slot per row; ascending doc_actors == actor-string sort
+    # order, so slot order is the OpId tie-break order within this doc
+    slot = jnp.argmax(
+        actor[:, None] == doc_actors[None, :], axis=1
+    ).astype(jnp.int32)
 
     # -- 1. supersession ------------------------------------------------
     tgt = jnp.where(ptgt >= 0, ptgt, N)
@@ -85,7 +134,7 @@ def _doc_kernel(
     # group id over (obj, key); 0 = not a map-located value op
     in_map = visible & (key >= 0)
     gid = jnp.where(in_map, (obj + 1) * (K + 1) + (key + 1), 0)
-    order = jnp.lexsort((actor, ctr, gid))
+    order = jnp.lexsort((slot, ctr, gid))
     g_sorted = gid[order]
     run_end = jnp.concatenate(
         [g_sorted[1:] != g_sorted[:-1], jnp.ones((1,), dtype=bool)]
@@ -95,7 +144,7 @@ def _doc_kernel(
 
     # -- 4. element values: winner per element -------------------------
     # OpId composite; +1 so 0 means "no visible value"
-    comp = ctr * jnp.int32(A) + actor + 1
+    comp = ctr * jnp.int32(A) + slot + 1
     is_elem_update = visible & ~is_ins & (key < 0) & (ref >= 0)
     own_value = visible & is_ins
     contrib = is_elem_update | own_value
@@ -166,10 +215,10 @@ def _doc_kernel(
         nxt_ext = nxt_ext[nxt_ext]
     rank = rank_ext[:N]
 
-    # -- 6. clock -------------------------------------------------------
+    # -- 6. clock (local slots; [A_loc], decoded via doc_actors) -------
     clock = (
         jnp.zeros(A, dtype=jnp.int32)
-        .at[jnp.where(valid, actor, 0)]
+        .at[jnp.where(valid, slot, 0)]
         .max(jnp.where(valid, seq, 0))
     )
 
@@ -188,12 +237,16 @@ def _doc_kernel(
 @partial(jax.jit, static_argnames=("A", "K"))
 def materialize_device(
     action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
-    A: int, K: int,
+    doc_actors, A: int, K: int,
 ) -> MaterializeOut:
-    """Batched kernel: all args [D, N] (pred edges [D, P])."""
+    """Batched kernel: all args [D, N] (pred edges [D, P], actor map
+    [D, A_loc])."""
     return jax.vmap(
         lambda *xs: _doc_kernel(*xs, A=A, K=K)
-    )(action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt)
+    )(
+        action, actor, ctr, seq, obj, key, ref, insert, value, psrc,
+        ptgt, doc_actors,
+    )
 
 
 class SummaryOut(NamedTuple):
@@ -224,13 +277,16 @@ def _pack_bits(mask: jax.Array) -> jax.Array:
 @partial(jax.jit, static_argnames=("A", "K"))
 def materialize_summary_device(
     action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
-    A: int, K: int,
+    doc_actors, A: int, K: int,
 ) -> SummaryOut:
     """Kernel + on-device summarization in ONE dispatch: the full per-row
     lanes (visible/rank/winner masks) never leave the device."""
     out = jax.vmap(
         lambda *xs: _doc_kernel(*xs, A=A, K=K)
-    )(action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt)
+    )(
+        action, actor, ctr, seq, obj, key, ref, insert, value, psrc,
+        ptgt, doc_actors,
+    )
     N = action.shape[1]
     order_key = jnp.where(
         out.elem_live, -out.rank, jnp.iinfo(jnp.int32).max
@@ -248,10 +304,46 @@ def materialize_summary_device(
     )
 
 
-def _device_args(batch: ColumnarBatch):
-    """(args, A, K) for the jitted kernels, with range checks applied."""
+def ensure_doc_actors(batch: ColumnarBatch):
+    """batch.doc_actors, deriving it from the actor column when a legacy
+    producer didn't supply one (cached back onto the batch)."""
+    import numpy as np
+
+    if batch.doc_actors is not None:
+        return batch.doc_actors
     A = max(1, len(batch.actors))
-    K = len(batch.keys)
+    D = batch.n_docs
+    valid = batch.cols["action"] != PAD
+    dcol = np.repeat(np.arange(D, dtype=np.int64), batch.n_rows)
+    acol = batch.cols["actor"].astype(np.int64).ravel()
+    pairs = np.unique((dcol * A + acol)[valid.ravel()])
+    batch.doc_actors = doc_actor_map_from_pairs(pairs, A, D)
+    return batch.doc_actors
+
+
+def bucket_doc_actors(batch: ColumnarBatch):
+    """(doc_actors padded to the A_loc bucket, A_loc, K): the pow2 bucket
+    shape (A_loc >= 4, K >= 16) shared by the single-device and sharded
+    paths so batches of different composition land in the same compiled
+    program — a bulk load's slabs all reuse one executable."""
+    import numpy as np
+
+    da = ensure_doc_actors(batch)
+    A = max(4, round_up_pow2(da.shape[1]))
+    if da.shape[1] < A:
+        da = np.concatenate(
+            [da, np.full((da.shape[0], A - da.shape[1]), -1, np.int32)],
+            axis=1,
+        )
+    K = max(16, round_up_pow2(max(1, len(batch.keys))))
+    return da, A, K
+
+
+def _device_args(batch: ColumnarBatch):
+    """(args, A_loc, K) for the jitted kernels, with range checks applied."""
+    _enable_persistent_compile_cache()
+
+    da, A, K = bucket_doc_actors(batch)
     c = batch.cols
     _check_ranges(batch, A, K)
     args = tuple(
@@ -260,7 +352,7 @@ def _device_args(batch: ColumnarBatch):
             "action", "actor", "ctr", "seq", "obj", "key", "ref",
             "insert", "value",
         )
-    ) + (jnp.asarray(batch.psrc), jnp.asarray(batch.ptgt))
+    ) + (jnp.asarray(batch.psrc), jnp.asarray(batch.ptgt), jnp.asarray(da))
     return args, A, K
 
 
@@ -277,13 +369,11 @@ def run_batch(batch: ColumnarBatch) -> MaterializeOut:
 
 
 def _check_ranges(batch: ColumnarBatch, A: int, K: int) -> None:
-    import numpy as np
-
     N = batch.n_rows
     max_ctr = int(batch.cols["ctr"].max(initial=0))
     if max_ctr * A + A >= 2**30:
         raise ValueError(
-            f"lamport x actor composite overflow: ctr={max_ctr} A={A}"
+            f"lamport x actor-slot composite overflow: ctr={max_ctr} A={A}"
         )
     if (N + 1) * (K + 1) + K >= 2**31:
         raise ValueError(f"obj x key group id overflow: N={N} K={K}")

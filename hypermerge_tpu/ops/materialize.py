@@ -65,11 +65,9 @@ class DecodedBatch:
         raise AttributeError(name)
 
     def clock_dict(self, d: int) -> Dict[str, int]:
-        return {
-            self.batch.actors[a]: int(s)
-            for a, s in enumerate(self.clock[d])
-            if s > 0
-        }
+        return _local_clock_dict(
+            self.batch, _doc_actors_row(self.batch, d), self.clock[d]
+        )
 
     def doc_view(self, d: int) -> "DocView":
         """A one-doc view whose lanes transfer individually — opening a
@@ -84,24 +82,43 @@ class DecodedBatch:
                     None
                 ]
         cols = {k: v[d : d + 1] for k, v in self.cols.items()}
-        return DocView(self.batch, cols, lanes)
+        return DocView(
+            self.batch, cols, lanes, _doc_actors_row(self.batch, d)
+        )
+
+
+def _doc_actors_row(batch: ColumnarBatch, d: int) -> np.ndarray:
+    from .crdt_kernels import ensure_doc_actors
+
+    return ensure_doc_actors(batch)[d]
+
+
+def _local_clock_dict(
+    batch: ColumnarBatch, doc_actors: np.ndarray, clock_row: np.ndarray
+) -> Dict[str, int]:
+    """Decode a [A_loc] local-slot clock through the doc's actor map."""
+    out: Dict[str, int] = {}
+    for slot, gid in enumerate(np.asarray(doc_actors).ravel()):
+        if gid < 0 or slot >= len(clock_row):
+            continue
+        s = int(clock_row[slot])
+        if s > 0:
+            out[batch.actors[int(gid)]] = s
+    return out
 
 
 class DocView:
     """One document's rows/lanes, shaped [1, N] — decode_patch(view, 0)."""
 
-    def __init__(self, batch, cols, lanes) -> None:
+    def __init__(self, batch, cols, lanes, doc_actors) -> None:
         self.batch = batch
         self.cols = cols
+        self.doc_actors = doc_actors
         for name, arr in lanes.items():
             setattr(self, name, arr)
 
     def clock_dict(self, _d: int) -> Dict[str, int]:
-        return {
-            self.batch.actors[a]: int(s)
-            for a, s in enumerate(self.clock[0])
-            if s > 0
-        }
+        return _local_clock_dict(self.batch, self.doc_actors, self.clock[0])
 
 
 def materialize_batch(

@@ -153,6 +153,9 @@ def synth_batch(
         strings=strings,
         floats=[],
         bigints=[],
+        doc_actors=np.tile(
+            np.arange(n_actors, dtype=np.int32), (D, 1)
+        ),
     )
 
 

@@ -36,27 +36,39 @@ _COL_ORDER = (
 
 def _batch_kernel(A: int, K: int):
     def fn(action, actor, ctr, seq, obj, key, ref, insert, value,
-           psrc, ptgt):
+           psrc, ptgt, doc_actors):
         return jax.vmap(lambda *xs: _doc_kernel(*xs, A=A, K=K))(
             action, actor, ctr, seq, obj, key, ref, insert, value,
-            psrc, ptgt,
+            psrc, ptgt, doc_actors,
         )
 
     return fn
 
 
 def shard_batch(batch: ColumnarBatch, mesh: Mesh):
-    """Pad the doc axis to the dp size and device_put with dp sharding."""
+    """Pad the doc axis to the dp size and device_put with dp sharding.
+
+    Returns (cols, psrc, ptgt, doc_actors, A_loc, K, D_pad) — A_loc/K
+    come from ops.crdt_kernels.bucket_doc_actors, the same bucketing the
+    single-device path uses, so both compile to bit-identical programs."""
     import numpy as np
 
+    from ..ops.crdt_kernels import (
+        _enable_persistent_compile_cache,
+        bucket_doc_actors,
+    )
+
+    _enable_persistent_compile_cache()
     dp = mesh.shape["dp"]
     D = batch.n_docs
     D_pad = pad_to_multiple(max(D, dp), dp)
     sh = doc_sharding(mesh)
 
     def put(arr, pad_value):
-        if D_pad != D:
-            pad = np.full((D_pad - D, *arr.shape[1:]), pad_value, arr.dtype)
+        if D_pad != arr.shape[0]:
+            pad = np.full(
+                (D_pad - arr.shape[0], *arr.shape[1:]), pad_value, arr.dtype
+            )
             arr = np.concatenate([arr, pad], axis=0)
         return jax.device_put(arr, sh)
 
@@ -68,33 +80,33 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
         cols[name] = put(batch.cols[name], pad_value)
     psrc = put(batch.psrc, -1)
     ptgt = put(batch.ptgt, -1)
-    return cols, psrc, ptgt, D_pad
+
+    da, A, K = bucket_doc_actors(batch)
+    doc_actors = put(da, -1)
+    return cols, psrc, ptgt, doc_actors, A, K, D_pad
+
+
+def _materialize_on_mesh(batch: ColumnarBatch, mesh: Mesh):
+    """(out, doc_actors): the sharded batched replay plus the dp-sharded
+    actor map it ran with (step reuses the map for the clock union)."""
+    cols, psrc, ptgt, doc_actors, A, K, _ = shard_batch(batch, mesh)
+    fn = jax.jit(
+        _batch_kernel(A, K),
+        in_shardings=(doc_sharding(mesh),) * 12,
+        out_shardings=MaterializeOut(
+            *([doc_sharding(mesh)] * len(MaterializeOut._fields))
+        ),
+    )
+    with mesh:
+        out = fn(*[cols[n] for n in _COL_ORDER], psrc, ptgt, doc_actors)
+    return out, doc_actors
 
 
 def sharded_materialize(
     batch: ColumnarBatch, mesh: Mesh
 ) -> MaterializeOut:
     """Batched replay sharded over dp; returns device-sharded outputs."""
-    A = max(1, len(batch.actors))
-    K = len(batch.keys)
-    cols, psrc, ptgt, _ = shard_batch(batch, mesh)
-    fn = jax.jit(
-        _batch_kernel(A, K),
-        in_shardings=(doc_sharding(mesh),) * 9
-        + (doc_sharding(mesh), doc_sharding(mesh)),
-        out_shardings=MaterializeOut(
-            dead=doc_sharding(mesh),
-            visible=doc_sharding(mesh),
-            map_winner=doc_sharding(mesh),
-            elem_winner=doc_sharding(mesh),
-            elem_live=doc_sharding(mesh),
-            rank=doc_sharding(mesh),
-            inc_total=doc_sharding(mesh),
-            clock=doc_sharding(mesh),
-        ),
-    )
-    with mesh:
-        return fn(*[cols[n] for n in _COL_ORDER], psrc, ptgt)
+    return _materialize_on_mesh(batch, mesh)[0]
 
 
 @partial(jax.jit, static_argnames=())
@@ -154,10 +166,28 @@ def sharded_dominated(clocks, query, mesh: Mesh):
         return fn(arr, q)[:D]
 
 
+def local_clock_union(clock, doc_actors, n_actors: int, mesh: Mesh):
+    """[D, A_loc] local-slot clocks + [D, A_loc] actor maps -> [n_actors]
+    global union. The scatter-max crosses dp shards, so XLA lowers the
+    replicated output to a max-allreduce over ICI."""
+    rep = NamedSharding(mesh, P())
+    fn = jax.jit(
+        lambda c, da: jnp.zeros(n_actors + 1, jnp.int32)
+        .at[jnp.where(da >= 0, da, n_actors).ravel()]
+        .max(jnp.where(da >= 0, c, 0).ravel())[:n_actors],
+        in_shardings=(doc_sharding(mesh), doc_sharding(mesh)),
+        out_shardings=rep,
+    )
+    with mesh:
+        return fn(clock, doc_actors)
+
+
 def step(batch: ColumnarBatch, mesh: Mesh):
     """One full merge step: materialize everything + union every clock.
     This is the framework's 'training step' analogue — the complete
     device-side work of a bulk sync cycle."""
-    out = sharded_materialize(batch, mesh)
-    union = sharded_clock_union(out.clock, mesh)
+    out, doc_actors = _materialize_on_mesh(batch, mesh)
+    union = local_clock_union(
+        out.clock, doc_actors, max(1, len(batch.actors)), mesh
+    )
     return out, union
