@@ -98,6 +98,8 @@ class RepoBackend:
             self.create(msg["publicKey"], msg["secretKey"])
         elif t == "Open":
             self.open(msg["id"])
+        elif t == "OpenBulk":
+            self.load_documents_bulk(msg["ids"])
         elif t == "Request":
             self.handle_request(msg["id"], msg["request"])
         elif t == "Merge":
@@ -141,7 +143,8 @@ class RepoBackend:
             doc = DocBackend(doc_id, self._doc_notify, None)
             self.docs[doc_id] = doc
         self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
-        self._load_document(doc)
+        if not self._load_document_fast(doc):
+            self._load_document(doc)
         return doc
 
     def merge(self, doc_id: str, clock: clockmod.Clock) -> None:
@@ -210,6 +213,98 @@ class RepoBackend:
             if actor is not None:
                 self._sync_changes(actor)
 
+    def _doc_feed_spec(self, doc_id: str, contiguous: Dict[str, bool]):
+        """(spec, clock, n_changes, actor_ids, ok) for a doc's cursor:
+        sidecar windows per actor feed plus the contiguous-seq clock
+        shortcut (clock[actor] = applied count is only sound when the
+        feed's seqs are 1..n — gap-y feeds set ok=False and must take
+        the safe per-op replay path). `contiguous` memoizes the per-feed
+        verification across docs sharing an actor."""
+        cursor = self.cursors.get(self.id, doc_id)
+        spec = []
+        clock: Dict[str, int] = {}
+        n_changes = 0
+        ok = True
+        for actor_id, max_seq in cursor.items():
+            actor = self._get_or_create_actor(actor_id)
+            fc = actor.columns()
+            good = contiguous.get(actor_id)
+            if good is None:
+                good = fc.seqs_contiguous()
+                contiguous[actor_id] = good
+                if not good:
+                    log(
+                        "repo:backend",
+                        f"feed {actor_id[:6]} has non-contiguous "
+                        "seqs; bulk clock shortcut unsafe",
+                    )
+            ok = ok and good
+            spec.append((fc, 0, max_seq))
+            applied = fc.changes_in_window(0, max_seq)
+            n_changes += applied
+            if applied > 0:
+                clock[actor_id] = applied  # seqs contiguous 1..n
+        return spec, clock, n_changes, list(cursor), ok
+
+    def _gate_unknown_empty(self, doc: DocBackend) -> None:
+        """No local history and no writable root: gate readiness until
+        the root actor's first change replicates in (the reference's
+        minimumClock render gate, src/DocBackend.ts:90-113)."""
+        root = root_actor_id(doc.id)
+        root_actor = self.actors.get(root)
+        if root_actor is None or not root_actor.writable:
+            doc.update_minimum_clock({root: 1})
+
+    def _resync_cursor_actors(self, actor_ids, synced: set) -> None:
+        """Blocks replicated while a (bulk or fast) load was in flight
+        hit _sync_changes before the doc could apply; re-run now (cheap
+        no-op when clocks already match), as _load_document does."""
+        for actor_id in actor_ids:
+            if actor_id in synced:
+                continue
+            synced.add(actor_id)
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                self._sync_changes(actor)
+
+    def _load_document_fast(self, doc: DocBackend) -> bool:
+        """Sidecar-backed cold open of ONE doc: pack its feed windows and
+        decode through the numpy kernel twin (ops/host_kernel.py) — no
+        per-op host replay, no device dispatch/compile. Returns False
+        (caller falls back to _load_document's replay) when a feed's
+        sidecar can't serve the window (non-contiguous seqs).
+        Replaces the reference's per-change Automerge replay for stored
+        histories (src/RepoBackend.ts:238-257 -> DocBackend.init)."""
+        if os.environ.get("HM_FAST_OPEN", "1") == "0":
+            return False
+        from ..ops.columnar import pack_docs_columns
+        from ..ops.host_kernel import run_batch_host
+        from ..ops.materialize import DecodedBatch, decode_patch
+
+        spec, clock, n_changes, actor_ids, ok = self._doc_feed_spec(
+            doc.id, {}
+        )
+        if not ok:
+            return False
+        writable = self._writable_actor_for(doc.id)
+        if n_changes == 0:
+            self._gate_unknown_empty(doc)
+        batch = pack_docs_columns([spec])
+        dec = DecodedBatch(batch, run_batch_host(batch))
+        doc.init_deferred(
+            loader=self._bulk_history_loader(doc.id),
+            clock=clock,
+            history_len=n_changes,
+            actor_id=writable,
+            snapshot_fn=lambda: decode_patch(dec, 0),
+            quiet=False,
+        )
+        self.clocks.update(self.id, doc.id, clock)
+        self._resync_cursor_actors(
+            self.cursors.get(self.id, doc.id), set()
+        )
+        return True
+
     def load_documents_bulk(
         self, doc_ids: List[str], slab: Optional[int] = None
     ) -> None:
@@ -232,57 +327,30 @@ class RepoBackend:
         entries = []  # (doc, spec, clock, n_changes, actor_ids)
         contiguous: Dict[str, bool] = {}  # per-actor-feed verification
         fallback_docs: List[DocBackend] = []
+        already_ready: List[str] = []  # open docs: frontend may re-read
         with self.db.bulk():  # one commit for thousands of upserts
             for doc_id in doc_ids:
                 with self._lock:
-                    if doc_id in self.docs:
+                    existing = self.docs.get(doc_id)
+                    if existing is not None:
+                        if existing._announced:
+                            already_ready.append(doc_id)
                         continue
                     doc = DocBackend(doc_id, self._doc_notify, None)
                     self.docs[doc_id] = doc
                 self.cursors.add_actor(
                     self.id, doc_id, root_actor_id(doc_id)
                 )
-                cursor = self.cursors.get(self.id, doc_id)
-                spec = []
-                clock: Dict[str, int] = {}
-                n_changes = 0
-                ok = True
-                for actor_id, max_seq in cursor.items():
-                    actor = self._get_or_create_actor(actor_id)
-                    fc = actor.columns()
-                    # the clock shortcut below assumes seqs 1..n; verify
-                    # once per feed and route gap-y feeds to the safe
-                    # per-doc replay path instead of mis-clocking
-                    good = contiguous.get(actor_id)
-                    if good is None:
-                        good = fc.seqs_contiguous()
-                        contiguous[actor_id] = good
-                        if not good:
-                            log(
-                                "repo:backend",
-                                f"feed {actor_id[:6]} has non-contiguous "
-                                "seqs; bulk clock shortcut unsafe",
-                            )
-                    ok = ok and good
-                    spec.append((fc, 0, max_seq))
-                    applied = fc.changes_in_window(0, max_seq)
-                    n_changes += applied
-                    if applied > 0:
-                        clock[actor_id] = applied  # seqs contiguous 1..n
+                spec, clock, n_changes, actor_ids, ok = (
+                    self._doc_feed_spec(doc_id, contiguous)
+                )
                 if not ok:
                     fallback_docs.append(doc)
                     continue
                 if n_changes == 0:
-                    # Unknown doc with no local history: same minimumClock
-                    # render gate _load_document applies — don't announce
-                    # an empty doc before the root actor's first change
-                    # replicates in.
-                    root = root_actor_id(doc_id)
-                    root_actor = self.actors.get(root)
-                    if root_actor is None or not root_actor.writable:
-                        doc.update_minimum_clock({root: 1})
+                    self._gate_unknown_empty(doc)
                 entries.append(
-                    (doc, spec, clock, n_changes, list(cursor))
+                    (doc, spec, clock, n_changes, actor_ids)
                 )
 
         ready_ids: List[str] = []
@@ -293,28 +361,24 @@ class RepoBackend:
             )
         for doc in fallback_docs:
             self._load_document(doc)
+        ready_ids.extend(already_ready)
         if ready_ids:
             self.to_frontend.push(msgs.bulk_ready_msg(ready_ids))
-        # Blocks replicated while the bulk load was in flight hit
-        # _sync_changes before the docs could apply; re-sync every actor
-        # now (cheap no-op when clocks already match), as _load_document
-        # does after init.
-        synced = set()
+        synced: set = set()
         for _doc, _spec, _clock, _n, actor_ids in entries:
-            for actor_id in actor_ids:
-                if actor_id in synced:
-                    continue
-                synced.add(actor_id)
-                actor = self.actors.get(actor_id)
-                if actor is not None:
-                    self._sync_changes(actor)
+            self._resync_cursor_actors(actor_ids, synced)
 
     def _load_slabs(
         self, entries, slab, pack_docs_columns, run_batch, DecodedBatch,
         decode_patch, ready_ids,
     ) -> None:
         from ..ops.columnar import round_up_pow2
+        from ..ops.host_kernel import run_batch_host
 
+        # small loads aren't worth a device dispatch (let alone a fresh
+        # per-bucket compile): under this many [D, N] cells the numpy
+        # kernel twin wins outright
+        min_cells = int(os.environ.get("HM_DEVICE_MIN_CELLS", "131072"))
         for base in range(0, len(entries), slab):
             chunk = entries[base : base + slab]
             # bucket the doc axis (pow2) so every slab of a bulk load —
@@ -322,7 +386,12 @@ class RepoBackend:
             batch = pack_docs_columns(
                 [e[1] for e in chunk], n_docs=round_up_pow2(len(chunk))
             )
-            dec = DecodedBatch(batch, run_batch(batch))
+            runner = (
+                run_batch_host
+                if batch.n_docs * batch.n_rows < min_cells
+                else run_batch
+            )
+            dec = DecodedBatch(batch, runner(batch))
             for j, (doc, _spec, clock, n_changes, actor_ids) in enumerate(
                 chunk
             ):

@@ -33,8 +33,49 @@ class DocFrontend:
         self._handles: List[Handle] = []
         self._change_queue: List[tuple] = []
         self._lock = threading.RLock()
+        # lazy-ready (bulk open): the backend has this doc materialized
+        # but the Ready (with its snapshot patch) is fetched only when a
+        # reader actually wants the value — a 10k-doc open_many must not
+        # decode 10k snapshots eagerly
+        self._lazy_ready = False
+        self._ready_requested = False
+        self._interested = False  # a reader poked before BulkReady landed
 
     # ------------------------------------------------------------------
+
+    def mark_lazy_ready(self) -> None:
+        """BulkReady: the backend can serve Ready on demand; fetch now
+        only if a reader already wants it (a poke recorded interest, a
+        subscriber attached, or a value() is blocking)."""
+        with self._lock:
+            self._lazy_ready = True
+            want = self._interested or any(
+                h.value_fn is not None for h in self._handles
+            )
+        if want:
+            self.request_ready()
+
+    def request_ready(self) -> None:
+        with self._lock:
+            if self._ready_requested or self.mode != "pending":
+                return
+            self._ready_requested = True
+        from .. import msgs
+
+        self._repo.to_backend.push(msgs.open_msg(self.doc_id))
+
+    def poke(self) -> None:
+        """A reader wants the value: resolve a pending lazy-ready doc.
+        Interest is recorded even before BulkReady lands (backend
+        messages may drain on another thread), so mark_lazy_ready can
+        honor it then."""
+        with self._lock:
+            if self.mode != "pending":
+                return
+            self._interested = True
+            if not self._lazy_ready:
+                return
+        self.request_ready()
 
     def handle(self) -> Handle:
         h = Handle(self)
@@ -50,6 +91,9 @@ class DocFrontend:
                 self._handles.remove(h)
 
     def change(self, fn: Callable[[Any], None], message: str = "") -> None:
+        # a lazy-ready doc must materialize before the change fn runs,
+        # else the fn would build ops against a blank document
+        self.poke()
         with self._lock:
             if self.mode == "pending" or self.actor_id is None:
                 self._change_queue.append((fn, message))
@@ -101,6 +145,13 @@ class DocFrontend:
     def on_actor_id(self, actor_id: str) -> None:
         with self._lock:
             self.actor_id = actor_id
+            if self.mode == "pending":
+                # Ready (with the snapshot patch) hasn't landed: flipping
+                # to write now would run queued change fns against a
+                # blank doc. on_ready runs them once state exists —
+                # matching the reference, where setActorId only enables
+                # writes on an initialized doc (src/DocFrontend.ts:110-119).
+                return
             self.seq = self.front.clock.get(actor_id, 0) + 1
             self.mode = "write"
             queued = list(self._change_queue)

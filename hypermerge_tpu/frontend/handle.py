@@ -51,6 +51,7 @@ class Handle(Generic[T]):
         if self.value_fn is not None:
             raise RuntimeError("handle already has a value subscriber")
         self.value_fn = fn
+        self._df.poke()  # resolve a lazy-ready (bulk-opened) doc
         if self._have_state.is_set():
             fn(self._state, self._index)
         return self
@@ -73,6 +74,7 @@ class Handle(Generic[T]):
     def value(self, timeout: Optional[float] = 10.0) -> T:
         """Blocking convenience: the latest materialized state (set as soon
         as the doc is ready)."""
+        self._df.poke()  # resolve a lazy-ready (bulk-opened) doc
         if not self._have_state.wait(timeout):
             raise TimeoutError(f"doc {self.id[:6]} never became ready")
         return self._state  # type: ignore[return-value]

@@ -63,6 +63,25 @@ class RepoFrontend:
         self.to_backend.push(msgs.open_msg(doc_id))
         return df.handle()
 
+    def open_many(self, urls) -> list:
+        """Bulk open: one OpenBulk message, one batched backend cold
+        start (device slabs), lazy Ready per doc — reading a handle (or
+        subscribing/changing) fetches that doc's snapshot then. The 10k-
+        doc cold start stays one XLA dispatch chain with zero eager
+        per-doc decodes. Contrast the reference's per-doc open loop
+        (src/RepoFrontend.ts:155-159 + src/RepoBackend.ts:238-257)."""
+        doc_ids = [validate_doc_url(u) for u in urls]
+        handles = []
+        with self._lock:
+            for doc_id in doc_ids:
+                df = self.docs.get(doc_id)
+                if df is None:
+                    df = DocFrontend(self, doc_id)
+                    self.docs[doc_id] = df
+                handles.append(df.handle())
+        self.to_backend.push(msgs.open_bulk_msg(doc_ids))
+        return handles
+
     def change(self, url: str, fn: Callable[[Any], None],
                message: str = "") -> None:
         doc_id = validate_doc_url(url)
@@ -220,11 +239,13 @@ class RepoFrontend:
 
             self.files = FileServerClient(msg["path"])
         elif t == "BulkReady":
-            # bulk cold start: docs are ready backend-side; any already-
-            # open frontends re-request their Ready (with snapshot patch)
+            # bulk cold start: docs are ready backend-side; open
+            # frontends fetch their Ready (with snapshot patch) lazily,
+            # on first read — never 10k eager decodes
             for doc_id in msg["ids"]:
-                if doc_id in self.docs:
-                    self.to_backend.push(msgs.open_msg(doc_id))
+                df = self.docs.get(doc_id)
+                if df is not None:
+                    df.mark_lazy_ready()
         else:
             log("repo:front", "unknown msg", t)
 

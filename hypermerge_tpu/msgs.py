@@ -27,6 +27,12 @@ def open_msg(doc_id: str) -> Dict[str, Any]:
     return {"type": "Open", "id": doc_id}
 
 
+def open_bulk_msg(doc_ids: List[str]) -> Dict[str, Any]:
+    """Open many docs in one batched cold start (backend
+    load_documents_bulk — the device slab path)."""
+    return {"type": "OpenBulk", "ids": list(doc_ids)}
+
+
 def request_msg(doc_id: str, request: Dict[str, Any]) -> Dict[str, Any]:
     """A local ChangeRequest (crdt.change.ChangeRequest.to_json())."""
     return {"type": "Request", "id": doc_id, "request": request}

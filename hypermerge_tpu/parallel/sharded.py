@@ -8,12 +8,14 @@ The reference processes documents one at a time on one Node thread
   with every [D, N] column sharded on dp. Per-doc compute has no cross-doc
   data flow, so XLA compiles this with zero collectives — linear scaling
   over chips.
-- `sharded_clock_union` / `sharded_dominated`: [D, A] clock matrices
-  sharded (dp, sp); the doc-axis reduction crosses shards, so XLA inserts
-  max-reduce collectives over ICI (the ClockStore bulk queries at 100k-doc
-  scale, BASELINE.json config 5).
-- `step`: one full "merge step" combining materialize + clock union —
-  what dryrun_multichip exercises end-to-end.
+- `sharded_clock_union` / `sharded_dominated`: GLOBAL-actor-indexed
+  [D, A] clock matrices (ClockStore rows — BASELINE config 5 bulk
+  queries) sharded (dp, sp); the doc-axis reduction crosses shards, so
+  XLA inserts max-reduce collectives over ICI. NOT for kernel clock
+  outputs: MaterializeOut.clock is slot-LOCAL ([D, A_loc], a different
+  actor per slot per doc) — decode those with `local_clock_union`.
+- `step`: one full "merge step" combining materialize + local clock
+  union — what dryrun_multichip exercises end-to-end.
 """
 
 from __future__ import annotations
@@ -131,8 +133,10 @@ def _pad_axes(arr, mesh: Mesh):
 
 
 def sharded_clock_union(clocks, mesh: Mesh):
-    """[D, A] -> [A] union across a (dp, sp)-sharded clock matrix; the
-    dp-axis max-reduce becomes an ICI collective."""
+    """[D, A] -> [A] union across a (dp, sp)-sharded clock matrix whose
+    columns are GLOBAL actor indices (ClockStore rows); the dp-axis
+    max-reduce becomes an ICI collective. Kernel clock outputs are
+    slot-local — use `local_clock_union` for those."""
     arr, _D, A = _pad_axes(clocks, mesh)
     sh = doc_actor_sharding(mesh)
     arr = jax.device_put(arr, sh)

@@ -40,6 +40,12 @@ class Repo:
     def open(self, url: str) -> Handle:
         return self.front.open(url)
 
+    def open_many(self, urls) -> list:
+        """Batched cold open: one backend bulk load (device slabs for
+        large counts), handles whose snapshots decode lazily on first
+        read. THE way to bring a big repo up (BASELINE config 4)."""
+        return self.front.open_many(urls)
+
     def doc(self, url: str, cb: Optional[Callable] = None) -> Any:
         return self.front.doc(url, cb)
 

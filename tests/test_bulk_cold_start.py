@@ -295,3 +295,64 @@ def test_actor_columns_rebuild_from_blocks(tmp_path):
         repo2.back.load_documents_bulk([doc_id])
         assert plainify(repo2.doc(url)) == want
         repo2.close()
+
+
+def test_fast_open_uses_sidecar_not_replay():
+    """An ordinary cold `open` of a cached doc decodes via the numpy
+    kernel twin — no host OpSet replay (VERDICT r2 item 2)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        repo = Repo(path=tmp)
+        url = repo.create({"x": 1, "t": Text("hello")})
+        repo.change(url, lambda d: d["t"].insert(5, "!"))
+        want = plainify(repo.doc(url))
+        repo.close()
+
+        repo2 = Repo(path=tmp)
+        h = repo2.open(url)
+        doc = repo2.back.docs[validate_doc_url(url)]
+        assert doc.opset is None, "fast open must not build an OpSet"
+        assert plainify(h.value()) == want
+        assert doc.opset is None
+        # incremental change still works (lazy OpSet reconstruction)
+        repo2.change(url, lambda d: d.__setitem__("y", 2))
+        got = plainify(repo2.doc(url))
+        assert got["y"] == 2 and got["t"] == want["t"]
+        repo2.close()
+
+
+def test_open_many_lazy_handles():
+    """open_many: one bulk backend load, snapshots decoded only when a
+    handle is actually read; change() on a lazy handle materializes
+    first."""
+    with tempfile.TemporaryDirectory() as tmp:
+        repo = Repo(path=tmp)
+        urls = [repo.create({"i": i}) for i in range(6)]
+        want = {u: plainify(repo.doc(u)) for u in urls}
+        repo.close()
+
+        repo2 = Repo(path=tmp)
+        handles = repo2.open_many(urls)
+        # backend is ready, but no snapshot decoded yet for unread docs
+        for u in urls:
+            doc = repo2.back.docs[validate_doc_url(u)]
+            assert doc._announced
+            assert doc.opset is None
+            assert doc._snapshot_cache is None, "decode must be lazy"
+        # reading a handle decodes just that doc
+        assert plainify(handles[2].value()) == want[urls[2]]
+        assert (
+            repo2.back.docs[validate_doc_url(urls[2])]._snapshot_cache
+            is not None
+        )
+        assert (
+            repo2.back.docs[validate_doc_url(urls[3])]._snapshot_cache
+            is None
+        )
+        # change on an unread lazy handle sees the materialized doc
+        handles[4].change(lambda d: d.__setitem__("j", 40))
+        got = plainify(handles[4].value())
+        assert got["i"] == 4 and got["j"] == 40
+        # open_many over already-open docs still yields live handles
+        handles2 = repo2.open_many(urls[:2])
+        assert plainify(handles2[0].value()) == want[urls[0]]
+        repo2.close()

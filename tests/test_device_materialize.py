@@ -201,3 +201,21 @@ def test_pack_roundtrip_values():
     assert docs[0]["big"] == 2**40
     assert docs[0]["f"] == 3.14159
     assert docs[0]["none_later"] is None
+
+
+def test_host_kernel_matches_device():
+    """ops/host_kernel.py is a bit-exact numpy twin of the device kernel
+    (the interactive single-doc open path must agree with bulk slabs)."""
+    from hypermerge_tpu.ops.crdt_kernels import run_batch
+    from hypermerge_tpu.ops.host_kernel import run_batch_host
+    from hypermerge_tpu.ops.columnar import pack_docs
+    from hypermerge_tpu.ops.synth import synth_batch, synth_changes
+
+    histories = [synth_changes(257, seed=s) for s in range(3)]
+    for batch in (pack_docs(histories), synth_batch(5, 192)):
+        dev = run_batch(batch)
+        host = run_batch_host(batch)
+        for f in host._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dev, f)), getattr(host, f), err_msg=f
+            )
