@@ -107,6 +107,7 @@ class ColumnarBatch:
     bigints: List[int]
     op_actor_ids: List[List[str]] = field(default_factory=list)
     doc_actors: Optional[np.ndarray] = None  # [D, A_loc] int32, -1 pad
+    slot: Optional[np.ndarray] = None  # [D, N] int16 local actor slots
 
     @property
     def shape(self) -> Tuple[int, int]:

@@ -1,0 +1,173 @@
+"""On-disk benchmark corpus writer — valid repo state, written fast.
+
+The cold-start benchmark (BASELINE configs 3/4: re-materialize 10k docs
+x 1k ops from disk) needs a repo directory holding real product state:
+per-actor block logs (storage/feed.py framing), columnar sidecars
+(storage/colcache.py layout), and the sqlite rows (cursors/clocks/feeds)
+a live repo would have persisted. Writing 10M ops through the
+interactive `repo.change` path takes minutes of pure Python; this writer
+produces byte-equivalent state directly:
+
+- `distinct` template histories come from ops/synth.py `synth_changes`
+  (single-writer chat-shaped docs, contiguous seqs 1..n);
+- each template's change blocks and sidecar files are rendered once,
+  then instantiated per doc by substituting the doc's actor id (the only
+  per-doc content) and re-packing blocks;
+- sqlite rows are written in one executemany per table.
+
+Equivalence with the interactive write path is pinned by
+tests/test_corpus.py: a corpus doc opens to exactly the state a repo
+that executed the same changes persists.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List
+
+import numpy as np
+
+from ..crdt.change import Change
+from ..storage import block as blockmod
+from ..storage.colcache import FeedColumnCache, MemoryColumnStorage
+from ..storage.sql import SqlDatabase
+from ..utils import keys as keymod
+from ..utils.ids import to_doc_url
+from ..utils.json_buffer import bufferify
+from .synth import synth_changes
+
+_HDR = struct.Struct("<I")  # storage/feed.py block framing
+_TEMPLATE_ACTOR = "actor00"  # synth_changes' single-writer actor name
+INFINITY_SEQ = 2**53 - 1  # crdt/clock.py INFINITY_SEQ
+
+
+class _Template:
+    """One synthetic history, pre-rendered for per-doc instantiation."""
+
+    def __init__(self, changes: List[Change]) -> None:
+        self.n_changes = len(changes)
+        self.raw_blocks = [bufferify(c.to_json()) for c in changes]
+        cc = FeedColumnCache(MemoryColumnStorage(), writer=_TEMPLATE_ACTOR)
+        for c in changes:
+            cc.append_change(c)
+        rows, preds, tables, commits = cc._storage.load()
+        self.rows_bytes = np.ascontiguousarray(rows, np.int32).tobytes()
+        self.preds_bytes = np.ascontiguousarray(preds, np.int32).tobytes()
+        self.commits_bytes = np.ascontiguousarray(
+            commits, np.int32
+        ).tobytes()
+        self.table_lines = tables
+
+
+def _write_doc(
+    feeds_root: str, pk: str, tpl: _Template, integrity_meta=None
+) -> None:
+    d = os.path.join(feeds_root, pk[:2])
+    os.makedirs(d, exist_ok=True)
+    pkb = pk.encode("ascii")
+    tab = _TEMPLATE_ACTOR.encode("ascii")
+    # block log: template JSON with the doc's actor substituted, packed
+    # through the product codec (storage/block.py)
+    parts: List[bytes] = []
+    for raw in tpl.raw_blocks:
+        b = blockmod.pack_raw(raw.replace(tab, pkb))
+        parts.append(_HDR.pack(len(b)))
+        parts.append(b)
+    with open(os.path.join(d, pk), "wb") as fh:
+        fh.write(b"".join(parts))
+    # sidecar: identical binary columns; only the writer's actor-table
+    # line names the doc
+    cdir = os.path.join(d, pk + ".cols")
+    os.makedirs(cdir, exist_ok=True)
+    with open(os.path.join(cdir, "rows.bin"), "wb") as fh:
+        fh.write(tpl.rows_bytes)
+    with open(os.path.join(cdir, "preds.bin"), "wb") as fh:
+        fh.write(tpl.preds_bytes)
+    with open(os.path.join(cdir, "commits.bin"), "wb") as fh:
+        fh.write(tpl.commits_bytes)
+    with open(os.path.join(cdir, "tables.jsonl"), "wb") as fh:
+        for line in tpl.table_lines:
+            fh.write(
+                line.replace(_TEMPLATE_ACTOR, pk).encode("utf-8") + b"\n"
+            )
+
+
+def make_corpus(
+    path: str,
+    n_docs: int,
+    n_ops: int,
+    ops_per_change: int = 16,
+    distinct: int = 8,
+    seed: int = 0,
+    threads: int = 8,
+) -> List[str]:
+    """Write a repo directory of `n_docs` single-writer docs with `n_ops`
+    ops each; returns their doc urls. Safe to call once per directory."""
+    feeds_root = os.path.join(path, "feeds")
+    os.makedirs(feeds_root, exist_ok=True)
+
+    templates = [
+        _Template(
+            synth_changes(
+                n_ops,
+                n_actors=1,
+                ops_per_change=ops_per_change,
+                seed=seed + t,
+            )
+        )
+        for t in range(min(distinct, n_docs))
+    ]
+
+    pairs = [keymod.create() for _ in range(n_docs)]
+
+    with ThreadPoolExecutor(max_workers=threads) as pool:
+        list(
+            pool.map(
+                lambda i: _write_doc(
+                    feeds_root,
+                    pairs[i].public_key,
+                    templates[i % len(templates)],
+                ),
+                range(n_docs),
+            )
+        )
+
+    db = SqlDatabase(os.path.join(path, "repo.db"))
+    repo_pair = keymod.create()
+    db.execute(
+        "INSERT OR REPLACE INTO keys (name, public_key, secret_key) "
+        "VALUES (?,?,?)",
+        ("self.repo", repo_pair.public_key, repo_pair.secret_key),
+    )
+    rid = repo_pair.public_key
+    with db.bulk():
+        db.executemany(
+            "INSERT OR REPLACE INTO cursors "
+            "(repo_id, doc_id, actor_id, seq) VALUES (?,?,?,?)",
+            [(rid, p.public_key, p.public_key, INFINITY_SEQ) for p in pairs],
+        )
+        db.executemany(
+            "INSERT OR REPLACE INTO clocks "
+            "(repo_id, doc_id, actor_id, seq) VALUES (?,?,?,?)",
+            [
+                (
+                    rid,
+                    p.public_key,
+                    p.public_key,
+                    templates[i % len(templates)].n_changes,
+                )
+                for i, p in enumerate(pairs)
+            ],
+        )
+        db.executemany(
+            "INSERT OR REPLACE INTO feeds "
+            "(public_id, discovery_id, is_writable) VALUES (?,?,0)",
+            [
+                (p.public_key, keymod.discovery_id(p.public_key))
+                for p in pairs
+            ],
+        )
+    db.close()
+    return [to_doc_url(p.public_key) for p in pairs]

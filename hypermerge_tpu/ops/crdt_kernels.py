@@ -94,26 +94,22 @@ def _ceil_log2(n: int) -> int:
 
 
 def _doc_kernel(
-    action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
+    action, slot, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
     doc_actors, *, A: int, K: int,
 ):
-    """One document. `actor` holds batch-global actor indices; `doc_actors`
-    [A] is this doc's ascending local actor map (-1 pad). A = A_loc, the
-    per-doc actor bucket — a small constant independent of how many docs
-    (and therefore distinct actors) share the batch, so the jit cache key
-    and the [A] clock output don't scale with slab size."""
+    """One document. `slot` holds per-doc LOCAL actor slots (precomputed
+    on host — ensure_slot); ascending `doc_actors` [A] maps slots back to
+    batch-global actor ids. A = A_loc, the per-doc actor bucket — a small
+    constant independent of how many docs (and therefore distinct actors)
+    share the batch, so the jit cache key and the [A] clock output don't
+    scale with slab size. Slot order == actor-string sort order, the OpId
+    tie-break order within this doc."""
     N = action.shape[0]
     idx = jnp.arange(N, dtype=jnp.int32)
     valid = action != PAD
     is_make = (action <= 3) & valid
     is_set = (action == _SET) & valid
     is_ins = (insert == 1) & valid
-
-    # local actor slot per row; ascending doc_actors == actor-string sort
-    # order, so slot order is the OpId tie-break order within this doc
-    slot = jnp.argmax(
-        actor[:, None] == doc_actors[None, :], axis=1
-    ).astype(jnp.int32)
 
     # -- 1. supersession ------------------------------------------------
     tgt = jnp.where(ptgt >= 0, ptgt, N)
@@ -208,12 +204,25 @@ def _doc_kernel(
 
     # Wyllie list-ranking: rank = #nodes from here to end of chain
     rank = jnp.where(in_forest, 1, 0).astype(jnp.int32)
-    rank_ext = jnp.concatenate([rank, jnp.zeros((1,), jnp.int32)])
-    nxt_ext = jnp.concatenate([nxt, jnp.array([N], jnp.int32)])
-    for _ in range(_ceil_log2(N) + 1):
-        rank_ext = rank_ext + rank_ext[nxt_ext]
-        nxt_ext = nxt_ext[nxt_ext]
-    rank = rank_ext[:N]
+    if N < 2**15:
+        # pack (rank, nxt) into one int32 lane: rank <= chain length <= N
+        # < 2^15 and nxt <= N, so `nxt | rank<<16` fits — one gather per
+        # round instead of two (the gathers, not the VPU work, bound
+        # these loops on TPU)
+        p = jnp.concatenate([nxt, jnp.array([N], jnp.int32)]) | (
+            jnp.concatenate([rank, jnp.zeros((1,), jnp.int32)]) << 16
+        )
+        for _ in range(_ceil_log2(N) + 1):
+            q = p[p & 0xFFFF]
+            p = (q & 0xFFFF) | ((p >> 16) + (q >> 16)) << 16
+        rank = (p >> 16)[:N]
+    else:
+        rank_ext = jnp.concatenate([rank, jnp.zeros((1,), jnp.int32)])
+        nxt_ext = jnp.concatenate([nxt, jnp.array([N], jnp.int32)])
+        for _ in range(_ceil_log2(N) + 1):
+            rank_ext = rank_ext + rank_ext[nxt_ext]
+            nxt_ext = nxt_ext[nxt_ext]
+        rank = rank_ext[:N]
 
     # -- 6. clock (local slots; [A_loc], decoded via doc_actors) -------
     clock = (
@@ -234,18 +243,51 @@ def _doc_kernel(
     )
 
 
+def _widen(flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt):
+    """Narrow wire dtypes -> int32 kernel lanes. The host packs columns
+    as small as their ranges allow (uint8 flags = action|insert<<3,
+    int8 slots, int16 rows/ids when they fit) because the host<->device
+    link — not the MXU/VPU — bounds the bulk path: widening on device is
+    fused VPU work, while every wire byte is wall-clock."""
+    i32 = jnp.int32
+    action = (flags & 7).astype(i32)
+    insert = ((flags >> 3) & 1).astype(i32)
+    return (
+        action, slot.astype(i32), ctr.astype(i32), seq.astype(i32),
+        obj.astype(i32), key.astype(i32), ref.astype(i32), insert,
+        value.astype(i32), psrc.astype(i32), ptgt.astype(i32),
+    )
+
+
+def batched_kernel(A: int, K: int):
+    """Batched (vmapped) kernel over narrow wire args — the function the
+    single-device jits and the mesh-sharded path (parallel/sharded.py)
+    both compile, so both lower to the same program."""
+
+    def fn(flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+           doc_actors):
+        (action, slot_w, ctr_w, seq_w, obj_w, key_w, ref_w, insert,
+         value_w, psrc_w, ptgt_w) = _widen(
+            flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt
+        )
+        return jax.vmap(lambda *xs: _doc_kernel(*xs, A=A, K=K))(
+            action, slot_w, ctr_w, seq_w, obj_w, key_w, ref_w, insert,
+            value_w, psrc_w, ptgt_w, doc_actors,
+        )
+
+    return fn
+
+
 @partial(jax.jit, static_argnames=("A", "K"))
 def materialize_device(
-    action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
+    flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
     doc_actors, A: int, K: int,
 ) -> MaterializeOut:
-    """Batched kernel: all args [D, N] (pred edges [D, P], actor map
-    [D, A_loc])."""
-    return jax.vmap(
-        lambda *xs: _doc_kernel(*xs, A=A, K=K)
-    )(
-        action, actor, ctr, seq, obj, key, ref, insert, value, psrc,
-        ptgt, doc_actors,
+    """Batched kernel: all args [D, N] narrow wire dtypes (pred edges
+    [D, P], actor map [D, A_loc])."""
+    return batched_kernel(A, K)(
+        flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+        doc_actors,
     )
 
 
@@ -274,20 +316,7 @@ def _pack_bits(mask: jax.Array) -> jax.Array:
     return (m.astype(jnp.uint8) * weights).sum(-1).astype(jnp.uint8)
 
 
-@partial(jax.jit, static_argnames=("A", "K"))
-def materialize_summary_device(
-    action, actor, ctr, seq, obj, key, ref, insert, value, psrc, ptgt,
-    doc_actors, A: int, K: int,
-) -> SummaryOut:
-    """Kernel + on-device summarization in ONE dispatch: the full per-row
-    lanes (visible/rank/winner masks) never leave the device."""
-    out = jax.vmap(
-        lambda *xs: _doc_kernel(*xs, A=A, K=K)
-    )(
-        action, actor, ctr, seq, obj, key, ref, insert, value, psrc,
-        ptgt, doc_actors,
-    )
-    N = action.shape[1]
+def _summarize(out: MaterializeOut, N: int) -> SummaryOut:
     order_key = jnp.where(
         out.elem_live, -out.rank, jnp.iinfo(jnp.int32).max
     )
@@ -302,6 +331,36 @@ def materialize_summary_device(
         n_map_entries=out.map_winner.sum(axis=1, dtype=jnp.int32),
         clock=out.clock,
     )
+
+
+@partial(jax.jit, static_argnames=("A", "K"))
+def materialize_summary_device(
+    flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+    doc_actors, A: int, K: int,
+) -> SummaryOut:
+    """Kernel + on-device summarization in ONE dispatch: the full per-row
+    lanes (visible/rank/winner masks) never leave the device."""
+    out = batched_kernel(A, K)(
+        flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+        doc_actors,
+    )
+    return _summarize(out, flags.shape[1])
+
+
+@partial(jax.jit, static_argnames=("A", "K"))
+def materialize_full_device(
+    flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+    doc_actors, A: int, K: int,
+):
+    """One dispatch -> (MaterializeOut, SummaryOut). The bulk loader uses
+    this: summaries transfer compactly for the materialization barrier,
+    while the full lanes stay device-resident for lazy per-doc patch
+    decode (DecodedBatch.doc_view)."""
+    out = batched_kernel(A, K)(
+        flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
+        doc_actors,
+    )
+    return out, _summarize(out, flags.shape[1])
 
 
 def ensure_doc_actors(batch: ColumnarBatch):
@@ -339,21 +398,84 @@ def bucket_doc_actors(batch: ColumnarBatch):
     return da, A, K
 
 
-def _device_args(batch: ColumnarBatch):
-    """(args, A_loc, K) for the jitted kernels, with range checks applied."""
-    _enable_persistent_compile_cache()
+def ensure_slot(batch: ColumnarBatch):
+    """[D, N] per-doc LOCAL actor slot per row (int16), derived from the
+    global actor column + doc_actors map and cached on the batch. One
+    vectorized searchsorted — rows of doc_actors are ascending, so a
+    doc-offset composite keeps the flat array sorted."""
+    import numpy as np
+
+    if batch.slot is not None:
+        return batch.slot
+    da = ensure_doc_actors(batch)
+    D, A = da.shape
+    stride = max(2, len(batch.actors) + 2)
+    docs = np.arange(D, dtype=np.int64)[:, None]
+    flat_da = np.where(
+        da < 0, stride - 1, da.astype(np.int64)
+    ) + docs * stride
+    comp = batch.cols["actor"].astype(np.int64) + docs * stride
+    slot = (
+        np.searchsorted(flat_da.ravel(), comp.ravel())
+        - (np.repeat(np.arange(D, dtype=np.int64), batch.n_rows) * A)
+    )
+    # PAD rows may name an actor outside the doc's set; clamp into [0, A)
+    batch.slot = np.clip(slot, 0, A - 1).astype(np.int16).reshape(D, -1)
+    return batch.slot
+
+
+def _narrow(arr, lo: int, hi: int):
+    """Smallest safe wire dtype for values known to lie in [lo, hi]."""
+    import numpy as np
+
+    if lo >= -(2**15) and hi < 2**15:
+        return np.ascontiguousarray(arr, dtype=np.int16)
+    return np.ascontiguousarray(arr, dtype=np.int32)
+
+
+def host_args(batch: ColumnarBatch):
+    """(numpy wire args, A_loc, K): the narrow columns every kernel entry
+    transfers. uint8 flags = action|insert<<3; int8 slot; int16 where the
+    value range fits (N-indexed columns whenever N < 32k — the common
+    case), int32 otherwise. Dtypes are a function of the (N, P) bucket
+    and value ranges, so slabs of one bulk load share one executable."""
+    import numpy as np
 
     da, A, K = bucket_doc_actors(batch)
+    slot = ensure_slot(batch)
     c = batch.cols
     _check_ranges(batch, A, K)
-    args = tuple(
-        jnp.asarray(c[k])
-        for k in (
-            "action", "actor", "ctr", "seq", "obj", "key", "ref",
-            "insert", "value",
-        )
-    ) + (jnp.asarray(batch.psrc), jnp.asarray(batch.ptgt), jnp.asarray(da))
+    N = batch.n_rows
+    flags = (
+        c["action"].astype(np.uint8) | (c["insert"].astype(np.uint8) << 3)
+    )
+    vmax = int(c["value"].max(initial=0))
+    vmin = int(c["value"].min(initial=0))
+    cmax = int(c["ctr"].max(initial=0))
+    smax = int(c["seq"].max(initial=0))
+    args = (
+        flags,
+        np.ascontiguousarray(
+            slot, dtype=np.int8 if A <= 127 else np.int16
+        ),
+        _narrow(c["ctr"], 0, cmax),
+        _narrow(c["seq"], 0, smax),
+        _narrow(c["obj"], -1, N - 1),
+        _narrow(c["key"], -1, max(0, len(batch.keys) - 1)),
+        _narrow(c["ref"], -3, N - 1),
+        _narrow(c["value"], vmin, vmax),
+        _narrow(batch.psrc, -1, N - 1),
+        _narrow(batch.ptgt, -1, N - 1),
+        np.ascontiguousarray(da, np.int32),
+    )
     return args, A, K
+
+
+def _device_args(batch: ColumnarBatch):
+    """(device args, A_loc, K) for the jitted kernels."""
+    _enable_persistent_compile_cache()
+    np_args, A, K = host_args(batch)
+    return tuple(jnp.asarray(a) for a in np_args), A, K
 
 
 def run_batch_summary(batch: ColumnarBatch) -> SummaryOut:
@@ -366,6 +488,12 @@ def run_batch(batch: ColumnarBatch) -> MaterializeOut:
     """Convenience host entry: pack numpy -> device -> outputs."""
     args, A, K = _device_args(batch)
     return materialize_device(*args, A=A, K=K)
+
+
+def run_batch_full(batch: ColumnarBatch):
+    """Host entry -> (MaterializeOut, SummaryOut) in one dispatch."""
+    args, A, K = _device_args(batch)
+    return materialize_full_device(*args, A=A, K=K)
 
 
 def _check_ranges(batch: ColumnarBatch, A: int, K: int) -> None:

@@ -28,36 +28,26 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.columnar import ColumnarBatch
-from ..ops.crdt_kernels import MaterializeOut, _doc_kernel
+from ..ops.crdt_kernels import MaterializeOut, batched_kernel
 from .mesh import doc_actor_sharding, doc_sharding, pad_to_multiple
 
-_COL_ORDER = (
-    "action", "actor", "ctr", "seq", "obj", "key", "ref", "insert", "value",
-)
-
-
-def _batch_kernel(A: int, K: int):
-    def fn(action, actor, ctr, seq, obj, key, ref, insert, value,
-           psrc, ptgt, doc_actors):
-        return jax.vmap(lambda *xs: _doc_kernel(*xs, A=A, K=K))(
-            action, actor, ctr, seq, obj, key, ref, insert, value,
-            psrc, ptgt, doc_actors,
-        )
-
-    return fn
+# narrow wire-arg order, matching ops.crdt_kernels.host_args; pad-doc
+# rows must decode to action=PAD (flags=7), insert=0
+_N_ARGS = 11  # flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt, da
+_PAD_VALUES = (7, 0, 0, 0, -1, -1, -3, 0, -1, -1, -1)
 
 
 def shard_batch(batch: ColumnarBatch, mesh: Mesh):
     """Pad the doc axis to the dp size and device_put with dp sharding.
 
-    Returns (cols, psrc, ptgt, doc_actors, A_loc, K, D_pad) — A_loc/K
-    come from ops.crdt_kernels.bucket_doc_actors, the same bucketing the
-    single-device path uses, so both compile to bit-identical programs."""
+    Returns (args, A_loc, K, D_pad) — the same narrow wire args (and the
+    same A_loc/K bucketing) as the single-device path, so both compile to
+    the same per-shard program; only the sharding differs."""
     import numpy as np
 
     from ..ops.crdt_kernels import (
         _enable_persistent_compile_cache,
-        bucket_doc_actors,
+        host_args,
     )
 
     _enable_persistent_compile_cache()
@@ -65,6 +55,7 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
     D = batch.n_docs
     D_pad = pad_to_multiple(max(D, dp), dp)
     sh = doc_sharding(mesh)
+    np_args, A, K = host_args(batch)
 
     def put(arr, pad_value):
         if D_pad != arr.shape[0]:
@@ -74,34 +65,24 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
             arr = np.concatenate([arr, pad], axis=0)
         return jax.device_put(arr, sh)
 
-    from ..ops.columnar import PAD
-
-    cols = {}
-    for name in _COL_ORDER:
-        pad_value = PAD if name == "action" else (-1 if name in ("obj", "key") else (-3 if name == "ref" else 0))
-        cols[name] = put(batch.cols[name], pad_value)
-    psrc = put(batch.psrc, -1)
-    ptgt = put(batch.ptgt, -1)
-
-    da, A, K = bucket_doc_actors(batch)
-    doc_actors = put(da, -1)
-    return cols, psrc, ptgt, doc_actors, A, K, D_pad
+    args = tuple(put(a, pv) for a, pv in zip(np_args, _PAD_VALUES))
+    return args, A, K, D_pad
 
 
 def _materialize_on_mesh(batch: ColumnarBatch, mesh: Mesh):
     """(out, doc_actors): the sharded batched replay plus the dp-sharded
     actor map it ran with (step reuses the map for the clock union)."""
-    cols, psrc, ptgt, doc_actors, A, K, _ = shard_batch(batch, mesh)
+    args, A, K, _ = shard_batch(batch, mesh)
     fn = jax.jit(
-        _batch_kernel(A, K),
-        in_shardings=(doc_sharding(mesh),) * 12,
+        batched_kernel(A, K),
+        in_shardings=(doc_sharding(mesh),) * _N_ARGS,
         out_shardings=MaterializeOut(
             *([doc_sharding(mesh)] * len(MaterializeOut._fields))
         ),
     )
     with mesh:
-        out = fn(*[cols[n] for n in _COL_ORDER], psrc, ptgt, doc_actors)
-    return out, doc_actors
+        out = fn(*args)
+    return out, args[-1]
 
 
 def sharded_materialize(

@@ -39,7 +39,12 @@ def _use_brotli() -> bool:
 
 
 def pack(obj: Any) -> bytes:
-    raw = bufferify(obj)
+    return pack_raw(bufferify(obj))
+
+
+def pack_raw(raw: bytes) -> bytes:
+    """Pack already-serialized JSON bytes (callers that template/replay
+    serialized changes skip the re-serialization)."""
     if _use_brotli():
         compressed = native.compress(
             native.CODEC_BROTLI, raw, quality=_BR_QUALITY

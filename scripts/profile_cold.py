@@ -1,0 +1,52 @@
+"""Profile the cold-start product path: make_corpus -> open_many.
+
+Usage: [PROF_DOCS=1024] [PROF_OPS=1024] [JAX_PLATFORMS=cpu] \
+       python scripts/profile_cold.py [--cprofile]
+"""
+
+import cProfile
+import os
+import pstats
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+n_docs = int(os.environ.get("PROF_DOCS", "1024"))
+n_ops = int(os.environ.get("PROF_OPS", "1024"))
+
+from hypermerge_tpu.ops.corpus import make_corpus  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+
+tmp = tempfile.mkdtemp(prefix="hmprof")
+t0 = time.perf_counter()
+urls = make_corpus(tmp, n_docs, n_ops)
+print(f"corpus: {n_docs} docs x {n_ops} ops in {time.perf_counter()-t0:.2f}s")
+
+t0 = time.perf_counter()
+repo = Repo(path=tmp)
+print(f"repo ctor: {time.perf_counter()-t0:.2f}s")
+
+
+def run():
+    t0 = time.perf_counter()
+    handles = repo.open_many(urls)
+    dt = time.perf_counter() - t0
+    print(
+        f"open_many: {dt:.2f}s -> {n_docs*n_ops/dt:,.0f} ops/s "
+        f"({len(handles)} handles)"
+    )
+
+
+if "--cprofile" in sys.argv:
+    prof = cProfile.Profile()
+    prof.enable()
+    run()
+    prof.disable()
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative").print_stats(35)
+else:
+    run()
+repo.close()
