@@ -1,48 +1,142 @@
-"""Benchmark: CRDT ops applied/sec/chip via batched device materialization.
+"""Benchmark: the cold-start PRODUCT path, disk -> materialized summaries.
 
-Workload: BASELINE.json config 4 shape — cold-start re-materialization of
-many chat-shaped docs (text RGA + LWW map churn) from packed op logs, in
-ONE device dispatch. Baseline = the host incremental OpSet replay of the
-same workload (the framework's own Node-CPU-backend equivalent; the
-reference publishes no numbers, BASELINE.md).
+Primary metric (BASELINE configs 3/4): a corpus of BENCH_DOCS docs x
+BENCH_OPS ops each — real feeds, sidecars, and sqlite rows on disk
+(ops/corpus.py, validated byte-equivalent to the interactive write path
+in tests/test_corpus.py) — opened with `Repo.open_many` in a FRESH
+RepoBackend and materialized to host through `fetch_bulk_summaries()`
+(the bulk path's honest barrier: after it, every doc renders host-side
+with no further device work). Nothing is pre-packed or pre-warmed: the
+timed region includes sqlite cursor/clock loads, sidecar IO, columnar
+packing, device transfer, kernel, and the summary fetch.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env overrides: BENCH_DOCS (default 4096), BENCH_OPS (default 1024),
-BENCH_HOST_DOCS (default 8).
+Two timed passes:
+  cold_first_process — first open in this process (includes XLA compile;
+    with the persistent compile cache hot this matches steady state)
+  steady_state       — second fresh RepoBackend over the same disk state
+    (compile cached; OS page cache warm). This is the headline: it is
+    what any long-lived deployment pays per cold open.
+
+Also measured (VERDICT r3 item 6):
+  config1_change_latency_us — interactive single-op change latency
+  config5_union_100k_ms     — 100k-doc ClockStore clock-union on device
+
+Baseline = the framework's own host incremental OpSet replay of the same
+per-doc histories (the reference publishes no numbers, BASELINE.md; the
+reference's own cold start is the same work in Node+Immutable.js).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"configs": {...}}. Env: BENCH_DOCS (default 10240), BENCH_OPS (1024),
+BENCH_HOST_DOCS (8), BENCH_DIR (corpus location, default a fresh tmpdir).
 """
 
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent))
 
 
+def _open_and_materialize(path, urls):
+    from hypermerge_tpu.repo import Repo
+
+    t0 = time.perf_counter()
+    repo = Repo(path=path)
+    handles = repo.open_many(urls)
+    summaries = repo.back.fetch_bulk_summaries()
+    dt = time.perf_counter() - t0
+    n = len(summaries.doc_ids)
+    assert n == len(urls), f"only {n}/{len(urls)} docs materialized"
+    assert len(handles) == len(urls)
+    stats = dict(repo.back.last_bulk_stats)
+    # spot-check: summaries carry real content
+    probe = summaries.doc(summaries.doc_ids[0])
+    assert probe["elems"] > 0 and probe["clock"], probe
+    repo.close()
+    return dt, stats
+
+
+def _config1_change_latency():
+    """Interactive path: µs per single-op change on a live doc."""
+    from hypermerge_tpu.repo import Repo
+
+    repo = Repo(memory=True)
+    url = repo.create({"n": 0})
+    ts = []
+    for i in range(300):
+        t0 = time.perf_counter()
+        repo.change(url, lambda d: d.__setitem__("n", i))
+        ts.append(time.perf_counter() - t0)
+    repo.close()
+    ts.sort()
+    return ts[len(ts) // 2] * 1e6  # median µs
+
+
+def _config5_union(n_docs=100_000, n_actors=64, seed=0):
+    """100k-doc clock union through the device kernel (ClockStore bulk
+    query shape, BASELINE config 5)."""
+    import numpy as np
+
+    from hypermerge_tpu.ops import clock_kernels as K
+
+    rng = np.random.default_rng(seed)
+    clocks = rng.integers(
+        0, 1000, size=(n_docs, n_actors), dtype=np.int32
+    )
+    rows = K.pack_clocks(clocks)
+    merged = np.asarray(K.union_reduce(rows))  # warm compile
+    t0 = time.perf_counter()
+    merged = np.asarray(K.union_reduce(K.pack_clocks(clocks)))
+    dt = time.perf_counter() - t0
+    assert merged.shape == (n_actors,)
+    return dt * 1e3  # ms
+
+
 def main() -> None:
-    n_docs = int(os.environ.get("BENCH_DOCS", "4096"))
+    n_docs = int(os.environ.get("BENCH_DOCS", "10240"))
     n_ops = int(os.environ.get("BENCH_OPS", "1024"))
     host_docs = int(os.environ.get("BENCH_HOST_DOCS", "8"))
 
     import jax
 
     from hypermerge_tpu.crdt.opset import OpSet
-    from hypermerge_tpu.ops.crdt_kernels import run_batch_summary
-    from hypermerge_tpu.ops.materialize import summarize_columnar
-    from hypermerge_tpu.ops.synth import synth_batch, synth_changes
+    from hypermerge_tpu.ops.corpus import make_corpus
+    from hypermerge_tpu.ops.synth import synth_changes
 
-    dev = jax.devices()[0]
-    print(f"# device: {dev}", file=sys.stderr)
+    print(f"# device: {jax.devices()[0]}", file=sys.stderr)
+    total_ops = n_docs * n_ops
 
-    # -- host baseline: incremental OpSet replay ------------------------
-    host_histories = [
-        synth_changes(n_ops, seed=i) for i in range(host_docs)
-    ]
+    # -- corpus on disk (untimed setup; BENCH_DIR reuses a prior one) --
+    bench_dir = os.environ.get("BENCH_DIR")
+    tmp = bench_dir or tempfile.mkdtemp(prefix="hm_bench")
+    manifest = os.path.join(tmp, "corpus.json")
+    if bench_dir and os.path.exists(manifest):
+        with open(manifest) as fh:
+            meta = json.load(fh)
+        assert meta["docs"] == n_docs and meta["ops"] == n_ops, meta
+        urls = meta["urls"]
+        print(f"# corpus: reusing {tmp}", file=sys.stderr)
+    else:
+        t0 = time.perf_counter()
+        urls = make_corpus(tmp, n_docs, n_ops, threads=16)
+        with open(manifest, "w") as fh:
+            json.dump({"docs": n_docs, "ops": n_ops, "urls": urls}, fh)
+        print(
+            f"# corpus: {n_docs} docs x {n_ops} ops written in "
+            f"{time.perf_counter()-t0:.1f}s -> {tmp}",
+            file=sys.stderr,
+        )
+
+    # -- host baseline: incremental OpSet replay -----------------------
     t0 = time.perf_counter()
-    for history in host_histories:
-        opset = OpSet()
-        opset.apply_changes(history)
+    for i in range(host_docs):
+        OpSet().apply_changes(
+            synth_changes(n_ops, n_actors=1, ops_per_change=16, seed=i)
+        )
     host_dt = time.perf_counter() - t0
     host_rate = host_docs * n_ops / host_dt
     print(
@@ -51,63 +145,52 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # -- device: one batched dispatch ----------------------------------
-    batch = synth_batch(n_docs, n_ops)
-    total_ops = int(batch.n_ops.sum())
-    # warmup: compiles the fused kernel AND the device->host transfer
-    # programs (on the tunneled platform each first-fetch of a new
-    # shape/dtype compiles a transfer executable; both caches are
-    # per-process, steady-state is what we measure)
-    t0 = time.perf_counter()
-    summarize_columnar(batch)
-    compile_dt = time.perf_counter() - t0
-    print(f"# warmup (kernel + transfer compiles): {compile_dt:.1f}s",
-          file=sys.stderr)
-
-    # kernel-only: dispatch + 1-element sync fetch (block_until_ready
-    # returns before compute completes on this platform — a fetch is the
-    # only honest barrier)
-    import numpy as np
-
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = run_batch_summary(batch)
-        np.asarray(out.clock.ravel()[:1])
-        times.append(time.perf_counter() - t0)
-    device_dt = min(times)
-    device_rate = total_ops / device_dt
-
-    # e2e: one summarize_columnar call = fused kernel+summary dispatch,
-    # compact device->host transfer, host bit-unpack
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        cols = summarize_columnar(batch)
-        times.append(time.perf_counter() - t0)
-    e2e_dt = min(times)
-    e2e_rate = total_ops / e2e_dt
-
+    # -- cold pass 1: fresh process (includes XLA compile) --------------
+    dt1, stats1 = _open_and_materialize(tmp, urls)
+    rate1 = total_ops / dt1
     print(
-        f"# device: {n_docs} docs x {n_ops} ops = {total_ops} ops, "
-        f"{device_dt*1e3:.0f}ms kernel-only, {e2e_dt*1e3:.0f}ms e2e "
-        f"(incl transfer+unpack) -> {device_rate:,.0f} ops/s kernel, "
-        f"{e2e_rate:,.0f} ops/s e2e",
+        f"# cold_first_process: {dt1:.2f}s -> {rate1:,.0f} ops/s "
+        f"(stats {stats1})",
         file=sys.stderr,
     )
+
+    # -- cold pass 2+3: fresh backend, compile cached (steady state).
+    # min-of-2: the host shares one CPU core with the device tunnel, so
+    # single-pass numbers swing ~2x with unrelated machine load.
+    dt2, stats2 = _open_and_materialize(tmp, urls)
+    dt3, _ = _open_and_materialize(tmp, urls)
+    dt2 = min(dt2, dt3)
+    rate2 = total_ops / dt2
     print(
-        f"# live elems: {int(cols['n_live_elems'].sum())}, "
-        f"map entries: {int(cols['n_map_entries'].sum())}",
+        f"# steady_state (min of 2): {dt2:.2f}s -> {rate2:,.0f} ops/s "
+        f"(stats {stats2})",
         file=sys.stderr,
     )
+    assert stats2.get("fallback", 0) == 0, stats2
+
+    cfg1 = _config1_change_latency()
+    print(f"# config1 change latency: {cfg1:.0f}us", file=sys.stderr)
+    cfg5 = _config5_union()
+    print(f"# config5 100k-doc union: {cfg5:.1f}ms", file=sys.stderr)
+
+    if not bench_dir:
+        shutil.rmtree(tmp, ignore_errors=True)
 
     print(
         json.dumps(
             {
-                "metric": "crdt_ops_materialized_per_sec_per_chip",
-                "value": round(e2e_rate),
+                "metric": "cold_open_materialize_ops_per_sec_per_chip",
+                "value": round(rate2),
                 "unit": "ops/s",
-                "vs_baseline": round(e2e_rate / host_rate, 2),
+                "vs_baseline": round(rate2 / host_rate, 2),
+                "configs": {
+                    "cold_open_s_10k_docs": round(dt2, 2),
+                    "cold_first_process_s": round(dt1, 2),
+                    "config1_change_latency_us": round(cfg1),
+                    "config5_union_100k_ms": round(cfg5, 1),
+                    "docs": n_docs,
+                    "ops_per_doc": n_ops,
+                },
             }
         )
     )

@@ -44,18 +44,27 @@ class Actor:
         self.feed = feed
         self._notify = notify
         self._lock = threading.RLock()
-        # slot per feed block: _UNSET until decoded; None = corrupt
-        self.changes: List[Any] = [_UNSET] * feed.length
+        # slot per feed block: _UNSET until decoded; None = corrupt.
+        # Lazily sized — feed.length forces the block-log scan, which a
+        # bulk cold open wants in its parallel prefetch, not in the
+        # serial actor-creation loop.
+        self._changes: Optional[List[Any]] = None
         self._colcache: FeedColumnCache = feed.colcache or FeedColumnCache(
             MemoryColumnStorage(), writer=self.id
         )
         feed.on_append(self._on_append)
         self._notify({"type": "ActorInitialized", "actor": self})
-        self._notify({"type": "ActorSync", "actor": self})
+        self._notify({"type": "ActorSync", "actor": self, "origin": "init"})
 
     @property
     def writable(self) -> bool:
         return self.feed.writable
+
+    @property
+    def changes(self) -> List[Any]:
+        if self._changes is None:
+            self._changes = [_UNSET] * self.feed.length
+        return self._changes
 
     @property
     def seq_head(self) -> int:
@@ -109,12 +118,25 @@ class Actor:
 
     def _on_append(self, index: int, data: bytes) -> None:
         with self._lock:
+            if self._changes is None:
+                # first touch happens via an append: size to the
+                # pre-append state (feed.length already counts `index`)
+                self._changes = [_UNSET] * index
             if index < len(self.changes):
-                return  # our own write_change already recorded it
-            change = self._parse_block(data, index)
-            self.changes.append(change)
+                if self.changes[index] is not _UNSET:
+                    return  # our own write_change already recorded it
+                # A concurrent first touch of `changes` raced this
+                # callback and pre-sized the list past `index` (it reads
+                # feed.length, which already counts this block). The
+                # slot is _UNSET, so this is still a fresh remote block:
+                # fall through and sync/notify as usual.
+            else:
+                self.changes.append(_UNSET)
+            self.changes[index] = self._parse_block(data, index)
             self._sync_cache_locked()
-        self._notify({"type": "ActorSync", "actor": self})
+        self._notify(
+            {"type": "ActorSync", "actor": self, "origin": "append"}
+        )
 
     def _sync_cache_locked(self) -> None:
         """Bring the columnar sidecar up to the feed head (decodes only

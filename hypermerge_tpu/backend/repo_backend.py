@@ -83,6 +83,13 @@ class RepoBackend:
         self.file_store: Optional[FileStore] = None
         self._file_server = None
         self._closed = False
+        # bulk-load state: deferred per-actor work (one executemany / one
+        # resync instead of per-feed sqlite + sync queries), and the
+        # device summary refs the materialization barrier fetches
+        self._bulk_deferred_syncs: Optional[set] = None
+        self._bulk_feed_rows: Optional[List] = None
+        self._pending_summaries: List = []
+        self.last_bulk_stats: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     # wiring
@@ -213,14 +220,21 @@ class RepoBackend:
             if actor is not None:
                 self._sync_changes(actor)
 
-    def _doc_feed_spec(self, doc_id: str, contiguous: Dict[str, bool]):
+    def _doc_feed_spec(
+        self,
+        doc_id: str,
+        contiguous: Dict[str, bool],
+        cursor: Optional[Dict[str, int]] = None,
+    ):
         """(spec, clock, n_changes, actor_ids, ok) for a doc's cursor:
         sidecar windows per actor feed plus the contiguous-seq clock
         shortcut (clock[actor] = applied count is only sound when the
         feed's seqs are 1..n — gap-y feeds set ok=False and must take
         the safe per-op replay path). `contiguous` memoizes the per-feed
-        verification across docs sharing an actor."""
-        cursor = self.cursors.get(self.id, doc_id)
+        verification across docs sharing an actor. Bulk callers pass the
+        pre-fetched `cursor` (one SELECT for the whole load)."""
+        if cursor is None:
+            cursor = self.cursors.get(self.id, doc_id)
         spec = []
         clock: Dict[str, int] = {}
         n_changes = 0
@@ -306,73 +320,160 @@ class RepoBackend:
         return True
 
     def load_documents_bulk(
-        self, doc_ids: List[str], slab: Optional[int] = None
+        self, doc_ids: List[str], slab: Optional[int] = None,
+        pad_docs: Optional[int] = None, pad_rows: Optional[int] = None,
     ) -> None:
         """Cold-start many docs with zero per-op host work (the north
         star, BASELINE config 4): each doc's feed windows come from the
         columnar sidecars (storage/colcache.py), pack vectorized
         (ops/columnar.py pack_docs_columns), and materialize in slab-sized
-        device dispatches. Docs come up ready with device-served clocks
+        device dispatches. Docs come up ready with host-verified clocks
         and lazily-decoded snapshot patches; the host OpSet reconstructs
         only when a doc takes its first incremental change
         (DocBackend.init_deferred). Contrast the reference's per-doc
-        loadDocument replay loop (src/RepoBackend.ts:238-257)."""
+        loadDocument replay loop (src/RepoBackend.ts:238-257).
+
+        Host-side work is batched, not per-doc: one cursor upsert + one
+        SELECT for all docs, one feed-registry executemany, one clock
+        executemany, parallel sidecar loads, and per-actor syncs deferred
+        to a single pass at the end. Device dispatches are async — the
+        materialization barrier is `fetch_bulk_summaries`.
+
+        `pad_docs`/`pad_rows` override the slab's jit bucket (benchmarks
+        prime a [4096, N] executable with a small load)."""
         from ..ops.columnar import pack_docs_columns
-        from ..ops.crdt_kernels import run_batch
         from ..ops.materialize import DecodedBatch, decode_patch
 
         if slab is None:
             slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
+        # summaries are for the latest load: drop refs nobody fetched so
+        # repeated open_many calls can't pin old slabs' host+device memory
+        self._pending_summaries = []
 
-        entries = []  # (doc, spec, clock, n_changes, actor_ids)
-        contiguous: Dict[str, bool] = {}  # per-actor-feed verification
-        fallback_docs: List[DocBackend] = []
+        # -- phase 1: register docs + one bulk cursor upsert/select -----
+        new_docs: List[DocBackend] = []
         already_ready: List[str] = []  # open docs: frontend may re-read
-        with self.db.bulk():  # one commit for thousands of upserts
+        with self._lock:
             for doc_id in doc_ids:
-                with self._lock:
-                    existing = self.docs.get(doc_id)
-                    if existing is not None:
-                        if existing._announced:
-                            already_ready.append(doc_id)
-                        continue
-                    doc = DocBackend(doc_id, self._doc_notify, None)
-                    self.docs[doc_id] = doc
-                self.cursors.add_actor(
-                    self.id, doc_id, root_actor_id(doc_id)
-                )
+                existing = self.docs.get(doc_id)
+                if existing is not None:
+                    if existing._announced:
+                        already_ready.append(doc_id)
+                    continue
+                doc = DocBackend(doc_id, self._doc_notify, None)
+                self.docs[doc_id] = doc
+                new_docs.append(doc)
+        with self.db.bulk():
+            self.cursors.add_actors(
+                self.id, [(d.id, root_actor_id(d.id)) for d in new_docs]
+            )
+        cursor_map = self.cursors.get_multiple(
+            self.id, [d.id for d in new_docs]
+        )
+
+        # -- phase 2: open every cursor actor, per-feed work deferred ---
+        needed: List[str] = []
+        seen: set = set()
+        for d in new_docs:
+            for actor_id in cursor_map[d.id]:
+                if actor_id not in seen:
+                    seen.add(actor_id)
+                    needed.append(actor_id)
+        self._begin_bulk_actors()
+        try:
+            actors = [self._get_or_create_actor(a) for a in needed]
+            self._prefetch_columns(actors)
+
+            # -- phase 3: per-doc feed specs ----------------------------
+            entries = []  # (doc, spec, clock, n_changes, actor_ids)
+            contiguous: Dict[str, bool] = {}
+            fallback_docs: List[DocBackend] = []
+            for doc in new_docs:
                 spec, clock, n_changes, actor_ids, ok = (
-                    self._doc_feed_spec(doc_id, contiguous)
+                    self._doc_feed_spec(
+                        doc.id, contiguous, cursor_map[doc.id]
+                    )
                 )
                 if not ok:
                     fallback_docs.append(doc)
                     continue
                 if n_changes == 0:
                     self._gate_unknown_empty(doc)
-                entries.append(
-                    (doc, spec, clock, n_changes, actor_ids)
-                )
+                entries.append((doc, spec, clock, n_changes, actor_ids))
 
-        ready_ids: List[str] = []
-        with self.db.bulk():
+            # -- phase 4: slab dispatches + one clock executemany -------
+            ready_ids: List[str] = []
+            clock_rows: Dict[str, Dict[str, int]] = {}
             self._load_slabs(
-                entries, slab, pack_docs_columns, run_batch, DecodedBatch,
-                decode_patch, ready_ids,
+                entries, slab, pack_docs_columns, DecodedBatch,
+                decode_patch, ready_ids, clock_rows, pad_docs, pad_rows,
             )
-        for doc in fallback_docs:
-            self._load_document(doc)
+            with self.db.bulk():
+                self.clocks.update_many(self.id, clock_rows)
+            for doc in fallback_docs:
+                self._load_document(doc)
+            self.last_bulk_stats = {
+                "docs": len(new_docs),
+                "fast": len(entries),
+                "fallback": len(fallback_docs),
+            }
+            if fallback_docs:
+                log(
+                    "repo:backend",
+                    f"bulk load: {len(fallback_docs)}/{len(new_docs)} "
+                    "docs fell back to per-op host replay "
+                    "(non-contiguous feed seqs)",
+                )
+        finally:
+            self._end_bulk_actors()
         ready_ids.extend(already_ready)
         if ready_ids:
             self.to_frontend.push(msgs.bulk_ready_msg(ready_ids))
-        synced: set = set()
-        for _doc, _spec, _clock, _n, actor_ids in entries:
-            self._resync_cursor_actors(actor_ids, synced)
+
+    def _begin_bulk_actors(self) -> None:
+        """Defer per-feed sqlite writes and actor syncs for the duration
+        of a bulk load (each would otherwise be a per-feed round trip)."""
+        with self._lock:
+            self._bulk_feed_rows = []
+            self._bulk_deferred_syncs = set()
+
+    def _end_bulk_actors(self) -> None:
+        with self._lock:
+            rows = self._bulk_feed_rows or []
+            deferred = self._bulk_deferred_syncs or set()
+            self._bulk_feed_rows = None
+            self._bulk_deferred_syncs = None
+        if rows:
+            with self.db.bulk():
+                self.feed_info.save_many(
+                    (f.public_key, f.discovery_id, f.writable)
+                    for f in rows
+                )
+        for actor_id in deferred:
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                self._sync_changes(actor)
+
+    def _prefetch_columns(self, actors: List[Actor]) -> None:
+        """Load every actor's columnar sidecar in parallel — the bulk of
+        cold-start IO; file reads drop the GIL so threads overlap it."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        big = [a for a in actors if a.feed.colcache is not None]
+        if len(big) < 2:
+            for a in actors:
+                a.columns()
+            return
+        workers = min(16, int(os.environ.get("HM_LOAD_THREADS", "8")))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(lambda a: a.columns(), actors))
 
     def _load_slabs(
-        self, entries, slab, pack_docs_columns, run_batch, DecodedBatch,
-        decode_patch, ready_ids,
+        self, entries, slab, pack_docs_columns, DecodedBatch,
+        decode_patch, ready_ids, clock_rows, pad_docs=None, pad_rows=None,
     ) -> None:
         from ..ops.columnar import round_up_pow2
+        from ..ops.crdt_kernels import run_batch_full
         from ..ops.host_kernel import run_batch_host
 
         # small loads aren't worth a device dispatch (let alone a fresh
@@ -384,14 +485,28 @@ class RepoBackend:
             # bucket the doc axis (pow2) so every slab of a bulk load —
             # and every later bulk load — reuses one compiled executable
             batch = pack_docs_columns(
-                [e[1] for e in chunk], n_docs=round_up_pow2(len(chunk))
+                [e[1] for e in chunk],
+                n_docs=pad_docs or round_up_pow2(len(chunk)),
+                n_rows=pad_rows,
             )
-            runner = (
-                run_batch_host
-                if batch.n_docs * batch.n_rows < min_cells
-                else run_batch
+            if batch.n_docs * batch.n_rows < min_cells:
+                out = run_batch_host(batch)
+                summary = None
+            else:
+                out, summary = run_batch_full(batch)  # async dispatch
+                if os.environ.get("HM_ASYNC_SUMMARY_COPY", "1") != "0":
+                    for leaf in summary:
+                        # start the device->host copy now so the barrier
+                        # (fetch_bulk_summaries) overlaps transfers with
+                        # the later slabs' pack + compute
+                        try:
+                            leaf.copy_to_host_async()
+                        except AttributeError:  # non-device backend
+                            pass
+            dec = DecodedBatch(batch, out)
+            self._pending_summaries.append(
+                ([e[0].id for e in chunk], batch, dec, summary)
             )
-            dec = DecodedBatch(batch, runner(batch))
             for j, (doc, _spec, clock, n_changes, actor_ids) in enumerate(
                 chunk
             ):
@@ -410,9 +525,21 @@ class RepoBackend:
                         lambda dec=dec, j=j: decode_patch(dec.doc_view(j), 0)
                     ),
                 )
-                self.clocks.update(self.id, doc.id, clock)
+                clock_rows[doc.id] = clock
                 if doc._announced:  # minimum-clock-gated docs wait
                     ready_ids.append(doc.id)
+
+    def fetch_bulk_summaries(self) -> "BulkSummaries":
+        """The materialization barrier for the preceding bulk load(s):
+        transfers every slab's compact device summary (winner/liveness
+        masks bit-packed, element order, clocks) to host and returns them.
+        After this, any doc in the load renders host-side with no further
+        device work. Clears the pending refs."""
+        from ..ops.materialize import BulkSummaries
+
+        pending = self._pending_summaries
+        self._pending_summaries = []
+        return BulkSummaries(pending)
 
     def _bulk_history_loader(self, doc_id: str):
         """Deferred host replay for a bulk-loaded doc: decode the feed
@@ -450,14 +577,21 @@ class RepoBackend:
     # ------------------------------------------------------------------
     # actors
 
+    def _save_feed_info(self, feed) -> None:
+        with self._lock:
+            if self._bulk_feed_rows is not None:
+                self._bulk_feed_rows.append(feed)  # row built at end
+                return
+        self.feed_info.save(
+            feed.public_key, feed.discovery_id, feed.writable
+        )
+
     def _init_actor(self, pair: keymod.KeyPair) -> Actor:
         feed = self.feeds.create(pair)
         actor = Actor(feed, self._actor_notify)
         with self._lock:
             self.actors[actor.id] = actor
-        self.feed_info.save(
-            feed.public_key, feed.discovery_id, feed.writable
-        )
+        self._save_feed_info(feed)
         if self.network is not None:
             self.network.announce_feed(feed)
         return actor
@@ -470,9 +604,7 @@ class RepoBackend:
             actor = Actor(feed, self._actor_notify)
             with self._lock:
                 self.actors[actor_id] = actor
-            self.feed_info.save(
-                feed.public_key, feed.discovery_id, feed.writable
-            )
+            self._save_feed_info(feed)
             if self.network is not None:
                 self.network.announce_feed(feed)
         return actor
@@ -543,6 +675,16 @@ class RepoBackend:
         t = event["type"]
         actor: Actor = event["actor"]
         if t == "ActorSync":
+            with self._lock:
+                if self._bulk_deferred_syncs is not None:
+                    # Bulk load in flight. Doc windows pack AFTER actor
+                    # creation, so creation-time syncs have nothing to
+                    # deliver — drop them instead of a per-feed query
+                    # storm. Appends landing mid-load (replication) are
+                    # deferred to one pass at the end.
+                    if event.get("origin") == "append":
+                        self._bulk_deferred_syncs.add(actor.id)
+                    return
             self._sync_changes(actor)
         elif t == "Download":
             for doc_id in self.cursors.docs_with_actor(self.id, actor.id):

@@ -61,9 +61,7 @@ class _Template:
         self.table_lines = tables
 
 
-def _write_doc(
-    feeds_root: str, pk: str, tpl: _Template, integrity_meta=None
-) -> None:
+def _write_doc(feeds_root: str, pk: str, tpl: _Template) -> None:
     d = os.path.join(feeds_root, pk[:2])
     os.makedirs(d, exist_ok=True)
     pkb = pk.encode("ascii")

@@ -315,13 +315,8 @@ def decode_columnar(dec: DecodedBatch) -> Dict[str, np.ndarray]:
     }
 
 
-def summarize_columnar(batch: ColumnarBatch) -> Dict[str, np.ndarray]:
-    """Bulk path: fused kernel+summary on device, compact transfer, bit
-    unpack on host. Same keys/values as decode_columnar(run_batch(...))."""
-    from .crdt_kernels import run_batch_summary
-
-    s = run_batch_summary(batch)
-    N = batch.n_rows
+def fetch_summary(s, N: int) -> Dict[str, np.ndarray]:
+    """Transfer a device SummaryOut to host numpy (bit unpack applied)."""
 
     def unpack(bits: np.ndarray) -> np.ndarray:
         return np.unpackbits(bits, axis=1, bitorder="little")[:, :N].astype(
@@ -336,6 +331,55 @@ def summarize_columnar(batch: ColumnarBatch) -> Dict[str, np.ndarray]:
         "n_map_entries": np.asarray(s.n_map_entries).astype(np.int64),
         "clock": np.asarray(s.clock),
     }
+
+
+def summarize_columnar(batch: ColumnarBatch) -> Dict[str, np.ndarray]:
+    """Bulk path: fused kernel+summary on device, compact transfer, bit
+    unpack on host. Same keys/values as decode_columnar(run_batch(...))."""
+    from .crdt_kernels import run_batch_summary
+
+    return fetch_summary(run_batch_summary(batch), batch.n_rows)
+
+
+class BulkSummaries:
+    """Host-side summaries of a bulk load's slabs — the product of the
+    materialization barrier (RepoBackend.fetch_bulk_summaries). Slab
+    arrays stay columnar (zero-copy for bulk consumers); `doc(id)` decodes
+    one doc's counts + clock on demand."""
+
+    def __init__(self, pending) -> None:
+        # pending: (doc_ids, batch, dec, device_summary_or_None) per slab
+        self.slabs: List[Tuple[List[str], ColumnarBatch, Dict]] = []
+        self._where: Dict[str, Tuple[int, int]] = {}
+        for doc_ids, batch, dec, summary in pending:
+            arrays = (
+                decode_columnar(dec)
+                if summary is None  # host-kernel slab: no device refs
+                else fetch_summary(summary, batch.n_rows)
+            )
+            self.slabs.append((doc_ids, batch, arrays))
+            for j, d in enumerate(doc_ids):
+                self._where[d] = (len(self.slabs) - 1, j)
+
+    @property
+    def doc_ids(self) -> List[str]:
+        return list(self._where.keys())
+
+    def arrays(self, doc_id: str) -> Tuple[Dict, int]:
+        """(slab arrays, row index) holding this doc."""
+        si, j = self._where[doc_id]
+        return self.slabs[si][2], j
+
+    def doc(self, doc_id: str) -> Dict[str, Any]:
+        si, j = self._where[doc_id]
+        doc_ids, batch, arrays = self.slabs[si]
+        return {
+            "elems": int(arrays["n_live_elems"][j]),
+            "map_entries": int(arrays["n_map_entries"][j]),
+            "clock": _local_clock_dict(
+                batch, _doc_actors_row(batch, j), arrays["clock"][j]
+            ),
+        }
 
 
 def text_join(dec: DecodedBatch, d: int, text_obj_row: int) -> str:

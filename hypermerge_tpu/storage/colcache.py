@@ -409,20 +409,27 @@ class FeedColumnCache:
     def __init__(self, storage, writer: str) -> None:
         self._storage = storage
         self._lock = threading.RLock()
+        self.writer = writer
+        self._loaded = False  # storage read is deferred: a bulk cold
+        # start creates thousands of caches serially but loads them in
+        # parallel (RepoBackend._prefetch_columns)
+
+    def _ensure_loaded(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
         self._actors = _Interner()
         self._keys = _Interner()
         self._strings = _Interner()
         self._floats = _Interner()
         self._bigints = _Interner()
-        self._pending_tables: List[str] = []
-        self.writer = writer
-
-        rows, preds, tables, commits = storage.load()
+        self._pending_tables = []
+        rows, preds, tables, commits = self._storage.load()
         self._apply_tables(tables)
         if self.writer not in self._actors:
             # fresh cache: actor 0 is the writer (the table line flushes
             # with the first commit)
-            self._intern("a", self._actors, writer)
+            self._intern("a", self._actors, self.writer)
         self._row_chunks: List[np.ndarray] = [rows] if len(rows) else []
         self._pred_chunks: List[np.ndarray] = [preds] if len(preds) else []
         self._n_rows_total = len(rows)
@@ -436,6 +443,8 @@ class FeedColumnCache:
     # -- table interning ----------------------------------------------
 
     def _apply_tables(self, lines: List[str]) -> None:
+        if not lines:
+            return
         kinds = {
             "a": self._actors,
             "k": self._keys,
@@ -443,13 +452,12 @@ class FeedColumnCache:
             "f": self._floats,
             "b": self._bigints,
         }
-        for line in lines:
-            rec = json.loads(line)
-            interner = kinds[rec["t"]]
+        # one C-level parse for the whole file beats a json.loads per line
+        # (bulk cold opens read tens of thousands of these)
+        for rec in json.loads("[" + ",".join(lines) + "]"):
+            t = rec["t"]
             v = rec["v"]
-            if rec["t"] == "b":
-                v = int(v)
-            interner.add(v)
+            kinds[t].add(int(v) if t == "b" else v)
 
     def _intern(self, kind: str, interner: _Interner, v: Any) -> int:
         if v in interner:
@@ -466,11 +474,13 @@ class FeedColumnCache:
     @property
     def n_changes(self) -> int:
         with self._lock:
+            self._ensure_loaded()
             return len(self._commits_arr) + len(self._commits_new)
 
     def append_change(self, change: Optional[Change]) -> None:
         """Encode one change (None = corrupt block placeholder)."""
         with self._lock:
+            self._ensure_loaded()
             if change is None:
                 self._storage.commit_change(
                     np.zeros((0, ROW_FIELDS), np.int32),
@@ -580,6 +590,7 @@ class FeedColumnCache:
         — blocks are the source of truth, so a cache that ran ahead (e.g.
         feed file replaced/truncated out-of-band) must rebuild."""
         with self._lock:
+            self._loaded = True  # reset state IS the loaded-fresh state
             self._storage.reset()
             self._actors = _Interner()
             self._keys = _Interner()
@@ -598,15 +609,20 @@ class FeedColumnCache:
 
     def columns(self) -> FeedColumns:
         with self._lock:
+            self._ensure_loaded()
             if self._cached is not None:
                 return self._cached
             rows = (
-                np.concatenate(self._row_chunks, axis=0)
+                self._row_chunks[0]
+                if len(self._row_chunks) == 1  # no-copy: fresh load
+                else np.concatenate(self._row_chunks, axis=0)
                 if self._row_chunks
                 else np.zeros((0, ROW_FIELDS), np.int32)
             )
             preds = (
-                np.concatenate(self._pred_chunks, axis=0)
+                self._pred_chunks[0]
+                if len(self._pred_chunks) == 1
+                else np.concatenate(self._pred_chunks, axis=0)
                 if self._pred_chunks
                 else np.zeros((0, PRED_FIELDS), np.int32)
             )

@@ -138,7 +138,8 @@ class Feed:
     ) -> None:
         self.public_key = public_key
         self.secret_key = secret_key
-        self.discovery_id = keymod.discovery_id(public_key)
+        self._discovery_id: Optional[str] = None  # lazy: ~40us of
+        # base58+blake2b per feed adds up over a 10k-feed cold open
         self._storage = storage
         self._lock = threading.RLock()
         self._append_listeners: List[Callable[[int, bytes], None]] = []
@@ -149,6 +150,12 @@ class Feed:
     @property
     def writable(self) -> bool:
         return self.secret_key is not None
+
+    @property
+    def discovery_id(self) -> str:
+        if self._discovery_id is None:
+            self._discovery_id = keymod.discovery_id(self.public_key)
+        return self._discovery_id
 
     @property
     def length(self) -> int:
@@ -209,6 +216,7 @@ class FeedStore:
         self._cache_fn = cache_fn
         self._feeds: Dict[str, Feed] = {}
         self._by_discovery: Dict[str, str] = {}
+        self._discovery_pending: List[Feed] = []  # ids computed lazily
         self._lock = threading.RLock()
         self.feed_q: Queue = Queue("feedstore")
 
@@ -232,7 +240,7 @@ class FeedStore:
                         self._cache_fn(public_key), writer=public_key
                     )
                 self._feeds[public_key] = feed
-                self._by_discovery[feed.discovery_id] = public_key
+                self._discovery_pending.append(feed)
                 self.feed_q.push(feed)
             elif secret_key is not None and feed.secret_key is None:
                 feed.secret_key = secret_key
@@ -258,13 +266,21 @@ class FeedStore:
                 return None
         return self._open(public_key, None)
 
+    def _drain_discovery_pending(self) -> None:
+        # caller holds the lock
+        for feed in self._discovery_pending:
+            self._by_discovery[feed.discovery_id] = feed.public_key
+        self._discovery_pending.clear()
+
     def by_discovery_id(self, discovery_id: str) -> Optional[Feed]:
         with self._lock:
+            self._drain_discovery_pending()
             pk = self._by_discovery.get(discovery_id)
             return self._feeds.get(pk) if pk else None
 
     def known_discovery_ids(self) -> List[str]:
         with self._lock:
+            self._drain_discovery_pending()
             return list(self._by_discovery.keys())
 
     def append(self, public_key: str, data: bytes) -> int:

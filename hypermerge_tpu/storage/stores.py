@@ -46,16 +46,16 @@ class ClockStore:
     ) -> Dict[str, clockmod.Clock]:
         ids = list(doc_ids)
         out: Dict[str, clockmod.Clock] = {d: {} for d in ids}
-        if not ids:
-            return out
-        marks = ",".join("?" for _ in ids)
-        rows = self.db.query(
-            f"SELECT doc_id, actor_id, seq FROM clocks "
-            f"WHERE repo_id=? AND doc_id IN ({marks})",
-            (repo_id, *ids),
-        )
-        for doc_id, actor, seq in rows:
-            out[doc_id][actor] = seq
+        for base in range(0, len(ids), 500):  # see CursorStore note
+            chunk = ids[base : base + 500]
+            marks = ",".join("?" for _ in chunk)
+            rows = self.db.query(
+                f"SELECT doc_id, actor_id, seq FROM clocks "
+                f"WHERE repo_id=? AND doc_id IN ({marks})",
+                (repo_id, *chunk),
+            )
+            for doc_id, actor, seq in rows:
+                out[doc_id][actor] = seq
         return out
 
     def update(
@@ -74,6 +74,23 @@ class ClockStore:
             ],
         )
         return self.get(repo_id, doc_id)
+
+    def update_many(
+        self, repo_id: str, clocks: Dict[str, clockmod.Clock]
+    ) -> None:
+        """Monotonic merge for many docs in one executemany (no per-doc
+        read-back — the bulk cold start writes thousands of clock rows)."""
+        self.db.executemany(
+            "INSERT INTO clocks (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (repo_id, doc_id, actor_id) DO UPDATE "
+            "SET seq=excluded.seq WHERE excluded.seq > seq",
+            [
+                (repo_id, d, a, _clamp(s))
+                for d, clock in clocks.items()
+                for a, s in clock.items()
+            ],
+        )
 
     def set(
         self, repo_id: str, doc_id: str, clock: clockmod.Clock
@@ -191,6 +208,40 @@ class CursorStore:
     ) -> None:
         self.update(repo_id, doc_id, {actor_id: seq})
 
+    def add_actors(
+        self, repo_id: str, entries, seq: float = math.inf
+    ) -> None:
+        """add_actor for many (doc_id, actor_id) pairs in one statement."""
+        s = _clamp(seq)
+        self.db.executemany(
+            "INSERT INTO cursors (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (repo_id, doc_id, actor_id) DO UPDATE "
+            "SET seq=excluded.seq WHERE excluded.seq > seq",
+            [(repo_id, d, a, s) for d, a in entries],
+        )
+
+    def get_multiple(
+        self, repo_id: str, doc_ids: Iterable[str]
+    ) -> Dict[str, clockmod.Clock]:
+        """Cursors for many docs in chunked IN queries (one bulk load =
+        a handful of SELECTs, not one per doc)."""
+        ids = list(doc_ids)
+        out: Dict[str, clockmod.Clock] = {d: {} for d in ids}
+        # 500 params per statement: safe under every SQLite build's
+        # SQLITE_MAX_VARIABLE_NUMBER (999 before 3.32)
+        for base in range(0, len(ids), 500):
+            chunk = ids[base : base + 500]
+            marks = ",".join("?" for _ in chunk)
+            rows = self.db.query(
+                f"SELECT doc_id, actor_id, seq FROM cursors "
+                f"WHERE repo_id=? AND doc_id IN ({marks})",
+                (repo_id, *chunk),
+            )
+            for doc_id, actor, seq in rows:
+                out[doc_id][actor] = seq
+        return out
+
     def docs_with_actor(self, repo_id: str, actor_id: str) -> List[str]:
         return [
             r[0]
@@ -246,6 +297,14 @@ class FeedInfoStore:
             "INSERT OR REPLACE INTO feeds "
             "(public_id, discovery_id, is_writable) VALUES (?,?,?)",
             (public_id, discovery_id, 1 if is_writable else 0),
+        )
+
+    def save_many(self, rows) -> None:
+        """(public_id, discovery_id, is_writable) triples, one statement."""
+        self.db.executemany(
+            "INSERT OR REPLACE INTO feeds "
+            "(public_id, discovery_id, is_writable) VALUES (?,?,?)",
+            [(p, d, 1 if w else 0) for p, d, w in rows],
         )
 
     def all_public_ids(self) -> List[str]:
