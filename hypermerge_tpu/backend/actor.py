@@ -53,6 +53,8 @@ class Actor:
             MemoryColumnStorage(), writer=self.id
         )
         feed.on_append(self._on_append)
+        feed.on_extended(self._on_extended)
+        self._pending_dl = [0, 0.0]  # bytes, ms since last Download event
         self._notify({"type": "ActorInitialized", "actor": self})
         self._notify({"type": "ActorSync", "actor": self, "origin": "init"})
 
@@ -102,21 +104,8 @@ class Actor:
             self._sync_cache_locked()
         # local writes don't re-notify sync: the doc already applied it
 
-    def deliver_remote_block(self, index: int, data: bytes) -> None:
-        """Replication path: a verified remote block arrives in order."""
-        t0 = time.perf_counter()
-        self.feed._append_raw(data)
-        self._notify(
-            {
-                "type": "Download",
-                "actor": self,
-                "index": index,
-                "size": len(data),
-                "time": (time.perf_counter() - t0) * 1e3,
-            }
-        )
-
     def _on_append(self, index: int, data: bytes) -> None:
+        t0 = time.perf_counter()
         with self._lock:
             if self._changes is None:
                 # first touch happens via an append: size to the
@@ -134,8 +123,30 @@ class Actor:
                 self.changes.append(_UNSET)
             self.changes[index] = self._parse_block(data, index)
             self._sync_cache_locked()
+            self._pending_dl[0] += len(data)
+            self._pending_dl[1] += (time.perf_counter() - t0) * 1e3
         self._notify(
             {"type": "ActorSync", "actor": self, "origin": "append"}
+        )
+
+    def _on_extended(self, start: int, end: int) -> None:
+        """Every non-local extension is a replicated download: one
+        progress event per network chunk (reference hypercore 'download'
+        -> ActorBlockDownloadedMsg, src/Actor.ts:120-126 — but chunk-
+        granular, so a 100k-block backfill is not 100k doc lookups)."""
+        with self._lock:
+            size, ms = self._pending_dl
+            self._pending_dl = [0, 0.0]
+        if size == 0:
+            return  # our own write_change (no parse happened)
+        self._notify(
+            {
+                "type": "Download",
+                "actor": self,
+                "index": end - 1,
+                "size": size,
+                "time": ms,
+            }
         )
 
     def _sync_cache_locked(self) -> None:

@@ -57,13 +57,20 @@ class RepoBackend:
             raise ValueError("need a path unless memory=True")
         self.path = path
         self.memory = memory
+        from ..storage.integrity import (
+            file_sig_storage_fn,
+            memory_sig_storage_fn,
+        )
+
         if memory:
             storage_fn = memory_storage_fn
             cache_fn = memory_column_storage_fn
+            sig_fn = memory_sig_storage_fn
             db_path = ":memory:"
         else:
             storage_fn = file_storage_fn(os.path.join(path, "feeds"))
             cache_fn = file_column_storage_fn(os.path.join(path, "feeds"))
+            sig_fn = file_sig_storage_fn(os.path.join(path, "feeds"))
             os.makedirs(path, exist_ok=True)
             db_path = os.path.join(path, "repo.db")
         self.db = SqlDatabase(db_path)
@@ -71,7 +78,7 @@ class RepoBackend:
         self.cursors = CursorStore(self.db)
         self.key_store = KeyStore(self.db)
         self.feed_info = FeedInfoStore(self.db)
-        self.feeds = FeedStore(storage_fn, cache_fn)
+        self.feeds = FeedStore(storage_fn, cache_fn, sig_fn)
         self.id: str = self.key_store.get_or_create("self.repo").public_key
         self.docs: Dict[str, DocBackend] = {}
         self.actors: Dict[str, Actor] = {}
@@ -88,6 +95,8 @@ class RepoBackend:
         # device summary refs the materialization barrier fetches
         self._bulk_deferred_syncs: Optional[set] = None
         self._bulk_feed_rows: Optional[List] = None
+        self._bulk_mutex = threading.Lock()  # serializes bulk loads:
+        # the deferral accumulators above are per-load state
         self._pending_summaries: List = []
         self.last_bulk_stats: Dict[str, int] = {}
 
@@ -341,11 +350,19 @@ class RepoBackend:
 
         `pad_docs`/`pad_rows` override the slab's jit bucket (benchmarks
         prime a [4096, N] executable with a small load)."""
+        if slab is None:
+            slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
+        with self._bulk_mutex:  # concurrent open_many calls serialize
+            self._load_documents_bulk_locked(
+                doc_ids, slab, pad_docs, pad_rows
+            )
+
+    def _load_documents_bulk_locked(
+        self, doc_ids, slab, pad_docs, pad_rows
+    ) -> None:
         from ..ops.columnar import pack_docs_columns
         from ..ops.materialize import DecodedBatch, decode_patch
 
-        if slab is None:
-            slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
         # summaries are for the latest load: drop refs nobody fetched so
         # repeated open_many calls can't pin old slabs' host+device memory
         self._pending_summaries = []

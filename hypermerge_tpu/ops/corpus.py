@@ -61,20 +61,32 @@ class _Template:
         self.table_lines = tables
 
 
-def _write_doc(feeds_root: str, pk: str, tpl: _Template) -> None:
+def _write_doc(
+    feeds_root: str, pair: keymod.KeyPair, tpl: _Template, sign: bool
+) -> None:
+    from ..storage.integrity import sign_chain
+
+    pk = pair.public_key
     d = os.path.join(feeds_root, pk[:2])
     os.makedirs(d, exist_ok=True)
     pkb = pk.encode("ascii")
     tab = _TEMPLATE_ACTOR.encode("ascii")
     # block log: template JSON with the doc's actor substituted, packed
-    # through the product codec (storage/block.py)
+    # through the product codec (storage/block.py); the .sig sidecar is
+    # the same record chain a live writer persists (integrity.sign_chain
+    # is the single source of truth for that format)
+    blocks = [
+        blockmod.pack_raw(raw.replace(tab, pkb)) for raw in tpl.raw_blocks
+    ]
     parts: List[bytes] = []
-    for raw in tpl.raw_blocks:
-        b = blockmod.pack_raw(raw.replace(tab, pkb))
+    for b in blocks:
         parts.append(_HDR.pack(len(b)))
         parts.append(b)
     with open(os.path.join(d, pk), "wb") as fh:
         fh.write(b"".join(parts))
+    if sign:
+        with open(os.path.join(d, pk + ".sig"), "wb") as fh:
+            fh.write(sign_chain(blocks, keymod.decode(pair.secret_key)))
     # sidecar: identical binary columns; only the writer's actor-table
     # line names the doc
     cdir = os.path.join(d, pk + ".cols")
@@ -100,9 +112,12 @@ def make_corpus(
     distinct: int = 8,
     seed: int = 0,
     threads: int = 8,
+    sign: bool = True,
 ) -> List[str]:
     """Write a repo directory of `n_docs` single-writer docs with `n_ops`
-    ops each; returns their doc urls. Safe to call once per directory."""
+    ops each; returns their doc urls. Safe to call once per directory.
+    `sign=False` skips the .sig sidecars (faster; such feeds cannot
+    replicate to strict peers)."""
     feeds_root = os.path.join(path, "feeds")
     os.makedirs(feeds_root, exist_ok=True)
 
@@ -125,8 +140,9 @@ def make_corpus(
             pool.map(
                 lambda i: _write_doc(
                     feeds_root,
-                    pairs[i].public_key,
+                    pairs[i],
                     templates[i % len(templates)],
+                    sign,
                 ),
                 range(n_docs),
             )

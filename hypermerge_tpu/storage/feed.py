@@ -143,9 +143,17 @@ class Feed:
         self._storage = storage
         self._lock = threading.RLock()
         self._append_listeners: List[Callable[[int, bytes], None]] = []
+        # chunk-granularity listeners: cb(start, end) once per extension
+        # (a verified multi-block chunk fires ONE of these but one
+        # on_append per block) — replication tails and progress events
+        # subscribe here to avoid per-block amplification
+        self._extend_listeners: List[Callable[[int, int], None]] = []
         # columnar sidecar (storage/colcache.py), attached by FeedStore
         # when a cache_fn is configured; maintained by Actor
         self.colcache = None
+        # signed-merkle state (storage/integrity.py), attached by
+        # FeedStore; loaded lazily (bulk cold opens never read it)
+        self.integrity = None
 
     @property
     def writable(self) -> bool:
@@ -163,19 +171,83 @@ class Feed:
             return len(self._storage)
 
     def append(self, data: bytes) -> int:
+        """Writer append: store the block AND extend the signed merkle
+        log (storage/integrity.py) before listeners fire, so replication
+        tails always have a signature covering what they push."""
         if not self.writable:
             raise PermissionError(f"feed {self.public_key[:8]} not writable")
-        return self._append_raw(data)
+        with self._lock:
+            self._storage.append(data)
+            index = len(self._storage) - 1
+            if self.integrity is not None:
+                self.integrity.sign_append(self, index, data)
+            listeners = list(self._append_listeners)
+            extended = list(self._extend_listeners)
+        for cb in listeners:
+            cb(index, data)
+        for cb in extended:
+            cb(index, index + 1)
+        return index
+
+    def append_verified(
+        self, start: int, blocks: List[bytes], length: int, sig: bytes
+    ) -> bool:
+        """Replication append: verify the sender's signed merkle root
+        over [0, length) BEFORE storing anything (the trust boundary —
+        reference: hypercore verifies every replicated block against the
+        feed key). Duplicate prefixes are tolerated; a gap or a bad
+        signature stores nothing and returns False."""
+        if self.integrity is None:
+            return False
+        with self._lock:
+            have = len(self._storage)
+            if length <= have:
+                return True  # nothing new (stale retransmit)
+            if start > have:
+                return False  # gap: caller re-requests from our head
+            eff = blocks[have - start :]
+            if have + len(eff) != length:
+                return False
+            res = self.integrity.verify_extension(
+                self, have, eff, length, sig
+            )
+            if res is None:
+                return False
+            root, new_leaves = res
+            indices = []
+            for b in eff:
+                self._storage.append(b)
+                indices.append(len(self._storage) - 1)
+            self.integrity.record_verified(length, root, sig, new_leaves)
+            listeners = list(self._append_listeners)
+            extended = list(self._extend_listeners)
+        for i, b in zip(indices, eff):
+            for cb in listeners:
+                cb(i, b)
+        for cb in extended:
+            cb(indices[0], length)
+        return True
+
+    def audit(self) -> bool:
+        """Re-hash the whole block log against the newest signed record
+        (on-disk tamper detection). True for an empty unsigned feed."""
+        if self.integrity is None:
+            return False
+        return self.integrity.audit(self)
 
     def _append_raw(self, data: bytes) -> int:
-        """Append without the writability check — replication delivering a
-        remote writer's verified blocks uses this."""
+        """Append without writability or signature checks. Only for
+        callers inside the local trust boundary (tests, migration tools);
+        replication MUST use append_verified."""
         with self._lock:
             self._storage.append(data)
             index = len(self._storage) - 1
             listeners = list(self._append_listeners)
+            extended = list(self._extend_listeners)
         for cb in listeners:
             cb(index, data)
+        for cb in extended:
+            cb(index, index + 1)
         return index
 
     def get(self, index: int) -> bytes:
@@ -194,6 +266,10 @@ class Feed:
         with self._lock:
             self._append_listeners.append(cb)
 
+    def on_extended(self, cb: Callable[[int, int], None]) -> None:
+        with self._lock:
+            self._extend_listeners.append(cb)
+
     def close(self) -> None:
         if self.colcache is not None:
             self.colcache.close()
@@ -211,9 +287,13 @@ class FeedStore:
         self,
         storage_fn: StorageFn,
         cache_fn: Optional[StorageFn] = None,
+        sig_fn: Optional[StorageFn] = None,
     ) -> None:
+        from .integrity import memory_sig_storage_fn
+
         self._storage_fn = storage_fn
         self._cache_fn = cache_fn
+        self._sig_fn = sig_fn or memory_sig_storage_fn
         self._feeds: Dict[str, Feed] = {}
         self._by_discovery: Dict[str, str] = {}
         self._discovery_pending: List[Feed] = []  # ids computed lazily
@@ -239,6 +319,11 @@ class FeedStore:
                     feed.colcache = FeedColumnCache(
                         self._cache_fn(public_key), writer=public_key
                     )
+                from .integrity import FeedIntegrity
+
+                feed.integrity = FeedIntegrity(
+                    self._sig_fn(public_key), public_key
+                )
                 self._feeds[public_key] = feed
                 self._discovery_pending.append(feed)
                 self.feed_q.push(feed)

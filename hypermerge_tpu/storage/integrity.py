@@ -1,0 +1,323 @@
+"""Feed integrity: ed25519-signed merkle log per feed — the trust model.
+
+Parity: hypercore's signed merkle tree (reference
+src/types/hypercore.d.ts:132-188 — every feed is an append-only log whose
+state is an ed25519 signature over a merkle root; replicas verify every
+extension against the feed's public key before storing it). SURVEY §2.4
+calls this the biggest native build item; the crypto primitives live in
+the C++ layer (native/src/hm_native.cpp) behind utils/crypto.py.
+
+Design (TPU-irrelevant, host-side, but built for the bulk scale):
+
+- leaf hash = blake2b32(0x00 || block) (domain-separated, crypto.leaf_hash)
+- tree = the promote-odd merkle over leaf hashes (crypto.merkle_root);
+  maintained incrementally as binary-counter PEAKS so a writer's append
+  is O(log n) hashing, not O(n) — equivalence with the bulk recompute is
+  pinned by tests/test_integrity.py.
+- signature = ed25519(seed, b"hm-feed-v1" || uint64le(length) || root),
+  one record per append: (length, root, sig). Records persist in a
+  `.sig` sidecar next to the block log (104-byte fixed records; a torn
+  tail truncates to the last whole record). Only the newest record is
+  needed to verify a full prefix; per-append records let a writer serve
+  a signature for ANY chunk boundary when streaming backfill.
+- replication (net/replication.py) verifies every inbound extension:
+  recompute root over (own leaves[0:start] + received blocks) and check
+  the sender's signature against the feed public key BEFORE _append_raw.
+  Tampered or unsigned extensions are dropped and logged
+  (HM_ALLOW_UNSIGNED_FEEDS=1 restores pre-signature interop).
+- `audit(feed)` re-hashes the whole log against the newest stored
+  record — detects on-disk tampering of blocks or sig records.
+
+Local writes by this process are inside the local trust boundary (as in
+the reference — hypercore trusts its own storage, sqlite rows included);
+verification guards the REPLICATION boundary, audit guards the disk.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import List, Optional, Tuple
+
+from ..utils import crypto
+from ..utils import keys as keymod
+from ..utils.debug import log
+
+_SIG_CONTEXT = b"hm-feed-v1"
+_REC = struct.Struct("<Q32s64s")  # length, root, signature
+
+_NODE_PREFIX = b"\x01"
+
+
+def _parent(left: bytes, right: bytes) -> bytes:
+    return crypto.blake2b32(_NODE_PREFIX + left + right)
+
+
+def signable(length: int, root: bytes) -> bytes:
+    return _SIG_CONTEXT + struct.pack("<Q", length) + root
+
+
+class Peaks:
+    """Incremental promote-odd merkle: binary-counter peaks.
+
+    `append(leaf)` is O(log n) amortized; `root()` folds the peaks
+    right-to-left with the same parent hash the bulk
+    crypto.merkle_root(leaves) computes, so both paths agree bit-for-bit
+    on every length."""
+
+    def __init__(self) -> None:
+        self.sizes: List[int] = []
+        self.hashes: List[bytes] = []
+        self.length = 0
+
+    def append(self, leaf_hash: bytes) -> None:
+        self.sizes.append(1)
+        self.hashes.append(leaf_hash)
+        while len(self.sizes) >= 2 and self.sizes[-1] == self.sizes[-2]:
+            right = self.hashes.pop()
+            left = self.hashes.pop()
+            s = self.sizes.pop() + self.sizes.pop()
+            self.hashes.append(_parent(left, right))
+            self.sizes.append(s)
+        self.length += 1
+
+    def root(self) -> bytes:
+        if not self.hashes:
+            return b"\x00" * 32
+        acc = self.hashes[-1]
+        for h in reversed(self.hashes[:-1]):
+            acc = _parent(h, acc)
+        return acc
+
+
+# ---------------------------------------------------------------------------
+# signature-record storage
+
+
+class MemorySigStorage:
+    def __init__(self) -> None:
+        self.records: List[Tuple[int, bytes, bytes]] = []
+
+    def append(self, length: int, root: bytes, sig: bytes) -> None:
+        self.records.append((length, root, sig))
+
+    def load(self) -> List[Tuple[int, bytes, bytes]]:
+        return list(self.records)
+
+    def close(self) -> None:  # pragma: no cover - nothing to do
+        pass
+
+
+class FileSigStorage:
+    """Fixed-size (length, root, sig) records; torn tail ignored."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+
+    def append(self, length: int, root: bytes, sig: bytes) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with open(self.path, "ab") as fh:
+            fh.write(_REC.pack(length, root, sig))
+
+    def load(self) -> List[Tuple[int, bytes, bytes]]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path, "rb") as fh:
+            raw = fh.read()
+        n = len(raw) // _REC.size
+        return [
+            _REC.unpack_from(raw, i * _REC.size) for i in range(n)
+        ]
+
+    def close(self) -> None:  # pragma: no cover - nothing to do
+        pass
+
+
+def memory_sig_storage_fn(_name: str) -> MemorySigStorage:
+    return MemorySigStorage()
+
+
+def file_sig_storage_fn(root: str):
+    def fn(name: str) -> FileSigStorage:
+        return FileSigStorage(os.path.join(root, name[:2], name + ".sig"))
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+
+
+class FeedIntegrity:
+    """Signed-merkle state of one feed.
+
+    Lazily loaded: bulk cold opens never touch it; replication and audit
+    do. The leaf-hash cache rebuilds from the feed's blocks on demand
+    (blocks are the source of truth, as with the columnar sidecar)."""
+
+    def __init__(self, store, public_key: str) -> None:
+        self._store = store
+        self.public_key = public_key
+        self._lock = threading.RLock()
+        self._records: Optional[List[Tuple[int, bytes, bytes]]] = None
+        self._peaks: Optional[Peaks] = None
+        self._leaves: List[bytes] = []
+
+    # -- records --------------------------------------------------------
+
+    def _ensure_records(self) -> List[Tuple[int, bytes, bytes]]:
+        if self._records is None:
+            self._records = self._store.load()
+        return self._records
+
+    @property
+    def signed_length(self) -> int:
+        recs = self._ensure_records()
+        return recs[-1][0] if recs else 0
+
+    def latest(self) -> Optional[Tuple[int, bytes, bytes]]:
+        recs = self._ensure_records()
+        return recs[-1] if recs else None
+
+    def records(self) -> List[Tuple[int, bytes, bytes]]:
+        return list(self._ensure_records())
+
+    def record_at(self, length: int) -> Optional[Tuple[int, bytes, bytes]]:
+        """The stored (length, root, sig) covering exactly `length`."""
+        for rec in reversed(self._ensure_records()):
+            if rec[0] == length:
+                return rec
+            if rec[0] < length:
+                break
+        return None
+
+    # -- leaf cache ------------------------------------------------------
+
+    def _ensure_leaves(self, feed, upto: int) -> List[bytes]:
+        """Leaf hashes for feed blocks [0, upto) — cached, extended from
+        the block log as needed."""
+        with self._lock:
+            if len(self._leaves) < upto:
+                blocks = feed.get_batch(len(self._leaves), upto)
+                self._leaves.extend(crypto.leaf_hash(b) for b in blocks)
+            return self._leaves[:upto]
+
+    def _ensure_peaks(self, feed, upto: int) -> Peaks:
+        with self._lock:
+            if self._peaks is None:
+                self._peaks = Peaks()
+            if self._peaks.length < upto:
+                for leaf in self._ensure_leaves(feed, upto)[
+                    self._peaks.length :
+                ]:
+                    self._peaks.append(leaf)
+            return self._peaks
+
+    # -- writer path ------------------------------------------------------
+
+    def sign_append(self, feed, index: int, data: bytes) -> None:
+        """Writer appended block `index`: extend the tree and store a
+        fresh signed record. Requires the feed's secret key."""
+        seed = keymod.decode(feed.secret_key)
+        with self._lock:
+            peaks = self._ensure_peaks(feed, index)
+            leaf = crypto.leaf_hash(data)
+            if len(self._leaves) == index:
+                self._leaves.append(leaf)
+            peaks.append(leaf)
+            root = peaks.root()
+            sig = crypto.sign(signable(index + 1, root), seed)
+            self._ensure_records().append((index + 1, root, sig))
+            self._store.append(index + 1, root, sig)
+
+    # -- replication boundary ---------------------------------------------
+
+    def verify_extension(
+        self, feed, start: int, blocks: List[bytes], length: int,
+        root_sig: bytes,
+    ) -> Optional[Tuple[bytes, List[bytes]]]:
+        """Check a claimed extension: blocks fill [start, length) on top
+        of our local prefix [0, start). Returns (root, new leaf hashes)
+        when the signature verifies against the feed public key; None
+        otherwise. Nothing is appended here. The prefix root comes from
+        the incremental peaks, so verifying a feed chunk-by-chunk is
+        O(chunk log n), not O(n) hashing per chunk."""
+        if length != start + len(blocks) or start > feed.length:
+            return None
+        with self._lock:
+            peaks = self._ensure_peaks(feed, start)
+            probe = Peaks()
+            probe.sizes = list(peaks.sizes)
+            probe.hashes = list(peaks.hashes)
+            probe.length = peaks.length
+            new_leaves = [crypto.leaf_hash(b) for b in blocks]
+            for leaf in new_leaves:
+                probe.append(leaf)
+            root = probe.root()
+            ok = crypto.verify(
+                signable(length, root),
+                root_sig,
+                keymod.decode(self.public_key),
+            )
+            return (root, new_leaves) if ok else None
+
+    def record_verified(
+        self, length: int, root: bytes, sig: bytes,
+        new_leaves: List[bytes],
+    ) -> None:
+        """Store the record for an extension that verify_extension
+        accepted and whose blocks the caller appended."""
+        with self._lock:
+            self._leaves.extend(new_leaves)
+            if self._peaks is not None:
+                for leaf in new_leaves:
+                    self._peaks.append(leaf)
+            self._ensure_records().append((length, root, sig))
+            self._store.append(length, root, sig)
+
+    # -- disk audit ---------------------------------------------------------
+
+    def audit(self, feed) -> bool:
+        """Re-hash the entire block log against the newest stored record.
+        False = blocks or records were tampered with on disk (or the sig
+        chain is missing while blocks exist). Reads the feed and
+        recomputes independently of the cached state — and takes no
+        integrity lock while reading the feed, so a concurrent writer
+        (feed lock -> integrity lock) cannot deadlock against it."""
+        rec = self.latest()
+        if rec is None:
+            return feed.length == 0
+        length, root, sig = rec
+        if length > feed.length:
+            return False  # records claim more than the log holds
+        blocks = feed.get_batch(0, length)
+        leaves = [crypto.leaf_hash(b) for b in blocks]
+        if crypto.merkle_root(leaves) != root:
+            return False
+        return crypto.verify(
+            signable(length, root), sig, keymod.decode(self.public_key)
+        )
+
+
+def sign_chain(blocks: List[bytes], seed: bytes) -> bytes:
+    """The packed .sig-file content a writer produces appending `blocks`
+    in order — one (length, root, sig) record per append. Single source
+    of truth for the record chain; the corpus writer and tests use this
+    so their on-disk state is byte-compatible with sign_append's."""
+    peaks = Peaks()
+    out: List[bytes] = []
+    for b in blocks:
+        peaks.append(crypto.leaf_hash(b))
+        root = peaks.root()
+        out.append(
+            _REC.pack(
+                peaks.length,
+                root,
+                crypto.sign(signable(peaks.length, root), seed),
+            )
+        )
+    return b"".join(out)
+
+
+def allow_unsigned() -> bool:
+    return os.environ.get("HM_ALLOW_UNSIGNED_FEEDS") == "1"

