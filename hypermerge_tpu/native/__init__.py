@@ -68,6 +68,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.hm_merkle_root.argtypes = [
         ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
     ]
+    lib.hm_x25519_base.restype = ctypes.c_int
+    lib.hm_x25519_base.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.hm_x25519.restype = ctypes.c_int
+    lib.hm_x25519.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.hm_aead_encrypt.restype = ctypes.c_long
+    lib.hm_aead_encrypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.c_char_p,
+    ]
+    lib.hm_aead_decrypt.restype = ctypes.c_long
+    lib.hm_aead_decrypt.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.c_char_p,
+    ]
     lib.hm_compress_bound.restype = ctypes.c_size_t
     lib.hm_compress_bound.argtypes = [ctypes.c_size_t]
     lib.hm_compress.restype = ctypes.c_long
@@ -93,11 +109,18 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("HM_NO_NATIVE"):
             return None
-        if not os.path.exists(_SO) and not _build():
-            return None
+        src = os.path.join(_DIR, "src", "hm_native.cpp")
+        stale = os.path.exists(_SO) and os.path.exists(src) and (
+            os.path.getmtime(src) > os.path.getmtime(_SO)
+        )
+        if (not os.path.exists(_SO) or stale) and not _build():
+            if not os.path.exists(_SO):
+                return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
-        except OSError:
+        except (OSError, AttributeError):
+            # unloadable, or a stale prebuilt .so missing newer symbols
+            # (rebuild failed): fall back to pure Python
             _lib = None
         return _lib
 
@@ -166,6 +189,56 @@ def merkle_root(leaves: bytes) -> Optional[bytes]:
     if lib.hm_merkle_root(leaves, len(leaves) // 32, out) != 0:
         return None
     return out.raw
+
+
+def x25519_base(sk: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    out = ctypes.create_string_buffer(32)
+    if lib.hm_x25519_base(sk, out) != 0:
+        return None
+    return out.raw
+
+
+def x25519(sk: bytes, pk: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    out = ctypes.create_string_buffer(32)
+    if lib.hm_x25519(sk, pk, out) != 0:
+        return None
+    return out.raw
+
+
+def aead_encrypt(key: bytes, nonce: bytes, msg: bytes) -> Optional[bytes]:
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    out = ctypes.create_string_buffer(len(msg) + 16)
+    n = lib.hm_aead_encrypt(key, nonce, msg, len(msg), out)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+_AEAD_FAIL = object()
+
+
+def aead_decrypt(key: bytes, nonce: bytes, ct: bytes):
+    """None = native unavailable; _AEAD_FAIL = authentication failed."""
+    lib = load()
+    if lib is None or not (lib.hm_caps() & CAP_SODIUM):
+        return None
+    if len(ct) < 16:
+        return _AEAD_FAIL
+    out = ctypes.create_string_buffer(max(len(ct) - 16, 1))
+    n = lib.hm_aead_decrypt(key, nonce, ct, len(ct), out)
+    if n == -2:
+        return None
+    if n < 0:
+        return _AEAD_FAIL
+    return out.raw[:n]
 
 
 CODEC_BROTLI = 1

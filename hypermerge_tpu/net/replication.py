@@ -54,6 +54,11 @@ def _chunk_blocks() -> int:
     return int(os.environ.get("HM_REPL_CHUNK", "1024"))
 
 
+def _chunk_bytes() -> int:
+    # well under tcp.py's 64MB frame cap even after base64+JSON framing
+    return int(os.environ.get("HM_REPL_CHUNK_BYTES", str(8 * 1024 * 1024)))
+
+
 class ReplicationManager:
     def __init__(
         self,
@@ -172,18 +177,39 @@ class ReplicationManager:
             })
 
     def _pick_boundary(self, feed: Feed, start: int) -> int:
-        """End of the next backfill chunk: the largest signed-record
-        length within the chunk budget, else the first record past
-        `start`, else the head (legacy unsigned feeds)."""
+        """End of the next backfill chunk, bounded in BLOCKS and BYTES
+        (a frame must stay far below tcp.py's 64MB cap): the largest
+        signed-record length within both budgets, else the first record
+        past `start`, else the head (legacy unsigned feeds)."""
         have = feed.length
         if feed.integrity is None:
             return have
         lengths = [r[0] for r in feed.integrity.records() if r[0] > start]
         if not lengths:
             return have
+        # shrink the block budget until the byte budget holds
         want = min(have, start + _chunk_blocks())
+        budget = _chunk_bytes()
+        total = 0
+        count = 0
+        for b in feed.get_batch(start, want):
+            total += len(b)
+            count += 1
+            if total > budget and count > 1:
+                count -= 1
+                break
+        want = start + max(count, 1)
         within = [l for l in lengths if l <= want]
-        return max(within) if within else min(lengths)
+        if within:
+            return max(within)
+        end = min(lengths)
+        if end - start > _chunk_blocks():
+            log(
+                "replication",
+                f"sparse signature records on {feed.public_key[:6]}: "
+                f"serving an oversized chunk {start}..{end}",
+            )
+        return end
 
     def _blocks_msg(self, feed: Feed, did: str, start: int, end: int):
         rec = (

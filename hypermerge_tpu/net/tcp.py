@@ -16,6 +16,7 @@ implementation would call.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -29,11 +30,15 @@ _MAX_FRAME = 64 * 1024 * 1024
 
 
 class TcpDuplex:
-    """Object-message duplex over one socket (JSON frames). Inbound
-    buffering rides utils.queue.Queue (same never-concurrent /
-    never-reordered guarantees as the rest of the stack)."""
+    """Object-message duplex over one socket (JSON frames, encrypted by
+    default — sodium kx handshake + per-frame ChaCha20-Poly1305 with
+    counter nonces, net/secure.py; the reference's noise wrapping,
+    src/PeerConnection.ts:36). Inbound buffering rides utils.queue.Queue
+    (same never-concurrent / never-reordered guarantees as the rest of
+    the stack). HM_TCP_PLAINTEXT=1 disables encryption (both ends must
+    agree)."""
 
-    def __init__(self, sock: socket.socket) -> None:
+    def __init__(self, sock: socket.socket, is_client: bool = False) -> None:
         from ..utils.queue import Queue
 
         self._sock = sock
@@ -42,8 +47,36 @@ class TcpDuplex:
         self._on_close: Optional[Callable[[], None]] = None
         self._lock = threading.RLock()
         self.closed = False
+        self._session = None
+        if os.environ.get("HM_TCP_PLAINTEXT") != "1":
+            from .secure import SecureSession
+
+            self._session = SecureSession(is_client)
+            try:
+                self._handshake()
+            except (OSError, ValueError) as e:
+                log("net:tcp", f"handshake failed: {e}")
+                self.close()
+                return
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+
+    def _handshake(self) -> None:
+        """Exchange ephemeral public keys (the only plaintext frames)."""
+        self._sock.settimeout(10)
+        pk = self._session.handshake_bytes
+        self._sock.sendall(_HDR.pack(len(pk)) + pk)
+        hdr = self._read_exact(_HDR.size)
+        if hdr is None:
+            raise OSError("peer closed during handshake")
+        (size,) = _HDR.unpack(hdr)
+        if size != 32:
+            raise ValueError(f"bad handshake frame size {size}")
+        peer_pk = self._read_exact(32)
+        if peer_pk is None:
+            raise OSError("peer closed during handshake")
+        self._session.complete(peer_pk)
+        self._sock.settimeout(None)
 
     def on_message(self, cb: Callable[[Any], None]) -> None:
         self._inbox.subscribe(cb)
@@ -64,6 +97,10 @@ class TcpDuplex:
         data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
         try:
             with self._wlock:
+                # nonce counters are per-direction and strictly ordered:
+                # encrypt under the same lock that orders the writes
+                if self._session is not None:
+                    data = self._session.encrypt(data)
                 self._sock.sendall(_HDR.pack(len(data)) + data)
         except OSError:
             self.close()
@@ -92,6 +129,13 @@ class TcpDuplex:
             payload = self._read_exact(size)
             if payload is None:
                 break
+            if self._session is not None:
+                payload = self._session.decrypt(payload)
+                if payload is None:
+                    # authentication failure = tampering or desync:
+                    # fatal, never skippable
+                    log("net:tcp", "ciphertext auth failed, closing")
+                    break
             try:
                 msg = json.loads(payload.decode("utf-8"))
             except ValueError:
@@ -140,16 +184,24 @@ class TcpSwarm(Swarm):
                 sock, _addr = self._server.accept()
             except OSError:
                 break
-            duplex = TcpDuplex(sock)
-            self._duplexes.append(duplex)
-            if self._cb is not None:
-                self._cb(duplex, ConnectionDetails(client=False))
+            # handshake per connection off-thread: one stalled dialer
+            # must not block the listener
+            threading.Thread(
+                target=self._handle_inbound, args=(sock,), daemon=True
+            ).start()
+
+    def _handle_inbound(self, sock: socket.socket) -> None:
+        duplex = TcpDuplex(sock, is_client=False)
+        self._duplexes.append(duplex)
+        if not duplex.closed and self._cb is not None:
+            self._cb(duplex, ConnectionDetails(client=False))
 
     def connect(self, address: Tuple[str, int]) -> None:
         sock = socket.create_connection(address, timeout=10)
-        duplex = TcpDuplex(sock)
+        sock.settimeout(None)
+        duplex = TcpDuplex(sock, is_client=True)
         self._duplexes.append(duplex)
-        if self._cb is not None:
+        if not duplex.closed and self._cb is not None:
             self._cb(duplex, ConnectionDetails(client=True))
 
     # discovery is external (reference: hyperswarm); topics are no-ops here

@@ -215,9 +215,6 @@ class TestReplicationVerification:
         fb = feeds_b.open_feed(pair.public_key)
         pa = object.__new__(NetworkPeer)
         pa.id = "X"
-        mgr_b._start_replicating(
-            pa, fb, announce_length=False
-        ) if hasattr(mgr_b, "_start_replicating") else None
         mgr_b._on_blocks(
             pa, fb.discovery_id, 0,
             [base64.b64encode(b"nosig").decode()], -1, None, 1,
@@ -236,6 +233,30 @@ class TestReplicationVerification:
             [base64.b64encode(b"nosig").decode()], -1, None, 1,
         )
         assert fb.read_all() == [b"nosig"]
+
+    def test_byte_bounded_chunks_converge(self, monkeypatch):
+        """Large blocks shrink the chunk so frames stay bounded in bytes,
+        not just block count (a 64KB-block feed must never produce a
+        frame past the transport cap)."""
+        monkeypatch.setenv("HM_REPL_CHUNK_BYTES", "2500")
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(10):
+            fa.append(bytes([i]) * 1000)  # 1KB blocks
+        fb = feeds_b.open_feed(pair.public_key)
+        sent_sizes = []
+        orig = mgr_a._blocks_msg
+
+        def spy(feed, did, start, end):
+            sent_sizes.append(end - start)
+            return orig(feed, did, start, end)
+
+        mgr_a._blocks_msg = spy
+        _connect(mgr_a, mgr_b)
+        assert fb.read_all() == fa.read_all()
+        assert sent_sizes and max(sent_sizes) <= 2
 
     def test_chunked_backfill_converges(self, monkeypatch):
         """A 30-block feed replicates in 7-block ack-paced chunks (no

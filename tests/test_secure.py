@@ -1,0 +1,158 @@
+"""Transport encryption: kx handshake + authenticated frames
+(VERDICT r3 missing #2 — reference wraps every socket in noise,
+src/PeerConnection.ts:36)."""
+
+import socket
+import struct
+import time
+
+import pytest
+
+from hypermerge_tpu import native
+from hypermerge_tpu.net.secure import SecureSession
+from hypermerge_tpu.net.tcp import TcpDuplex, TcpSwarm
+from hypermerge_tpu.utils import chacha
+
+_HDR = struct.Struct("<I")
+
+
+class TestPrimitives:
+    def test_pure_x25519_agrees_with_itself(self):
+        sk1, sk2 = b"\x01" * 32, b"\x02" * 32
+        pk1 = chacha.x25519_base(sk1)
+        pk2 = chacha.x25519_base(sk2)
+        assert chacha.x25519(sk1, pk2) == chacha.x25519(sk2, pk1)
+
+    def test_rfc7748_vector(self):
+        # RFC 7748 §5.2 test vector 1
+        k = bytes.fromhex(
+            "a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"
+        )
+        u = bytes.fromhex(
+            "e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"
+        )
+        want = bytes.fromhex(
+            "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+        )
+        assert chacha.x25519(k, u) == want
+
+    def test_aead_roundtrip_and_tamper(self):
+        key, nonce = b"k" * 32, b"n" * 12
+        ct = chacha.aead_encrypt(key, nonce, b"secret payload")
+        assert chacha.aead_decrypt(key, nonce, ct) == b"secret payload"
+        bad = ct[:-1] + bytes([ct[-1] ^ 1])
+        assert chacha.aead_decrypt(key, nonce, bad) is None
+
+    @pytest.mark.skipif(not native.available(), reason="no native layer")
+    def test_pure_interops_with_native(self):
+        sk = b"\x07" * 32
+        assert chacha.x25519_base(sk) == native.x25519_base(sk)
+        key, nonce = b"K" * 32, b"N" * 12
+        msg = b"cross-implementation frame"
+        assert native.aead_decrypt(
+            key, nonce, chacha.aead_encrypt(key, nonce, msg)
+        ) == msg
+        assert chacha.aead_decrypt(
+            key, nonce, native.aead_encrypt(key, nonce, msg)
+        ) == msg
+
+
+class TestSecureSession:
+    def _pair(self):
+        c, s = SecureSession(True), SecureSession(False)
+        c.complete(s.handshake_bytes)
+        s.complete(c.handshake_bytes)
+        return c, s
+
+    def test_roundtrip_both_directions(self):
+        c, s = self._pair()
+        assert s.decrypt(c.encrypt(b"hello")) == b"hello"
+        assert c.decrypt(s.encrypt(b"world")) == b"world"
+        # counters advance: repeated frames differ on the wire
+        w1, w2 = c.encrypt(b"same"), c.encrypt(b"same")
+        assert w1 != w2
+        assert s.decrypt(w1) == b"same" and s.decrypt(w2) == b"same"
+
+    def test_tampered_frame_rejected(self):
+        c, s = self._pair()
+        wire = bytearray(c.encrypt(b"payload"))
+        wire[3] ^= 0x40
+        assert s.decrypt(bytes(wire)) is None
+
+    def test_wire_is_not_plaintext(self):
+        c, s = self._pair()
+        assert b"payload" not in c.encrypt(b'{"x": "payload"}')
+
+    def test_low_order_handshake_key_rejected(self):
+        s = SecureSession(False)
+        with pytest.raises(ValueError):
+            s.complete(b"\x00" * 32)  # neutral-element point -> q = 0
+
+
+class TestTcpEncrypted:
+    def _duplex_pair(self):
+        a, b = socket.socketpair()
+        import threading
+
+        out = {}
+
+        def server():
+            out["s"] = TcpDuplex(b, is_client=False)
+
+        t = threading.Thread(target=server)
+        t.start()
+        da = TcpDuplex(a, is_client=True)
+        t.join()
+        return da, out["s"], a, b
+
+    def test_encrypted_roundtrip(self):
+        da, db, _a, _b = self._duplex_pair()
+        got = []
+        db.on_message(got.append)
+        da.send({"secret": "value"})
+        for _ in range(100):
+            if got:
+                break
+            time.sleep(0.01)
+        assert got == [{"secret": "value"}]
+        da.close()
+        db.close()
+
+    def test_tampered_ciphertext_drops_connection(self):
+        da, db, a, _b = self._duplex_pair()
+        got = []
+        db.on_message(got.append)
+        # inject a forged frame directly on the raw socket, bypassing
+        # da's session: authentication must fail and db must close
+        forged = b"\x00" * 24
+        a.sendall(_HDR.pack(len(forged)) + forged)
+        for _ in range(200):
+            if db.closed:
+                break
+            time.sleep(0.01)
+        assert db.closed
+        assert got == []
+        da.close()
+
+    def test_two_repos_converge_over_encrypted_tcp(self):
+        from hypermerge_tpu.repo import Repo
+        from hypermerge_tpu.utils.ids import validate_doc_url
+
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"enc": "rypted"})
+        doc_id = validate_doc_url(url)
+        h = rb.open(url)
+        for _ in range(200):
+            doc = rb.back.docs.get(doc_id)
+            if doc is not None and doc._announced:
+                break
+            time.sleep(0.02)
+        assert h.value()["enc"] == "rypted"
+        ra.close()
+        rb.close()
+        sa.destroy()
+        sb.destroy()
