@@ -421,6 +421,11 @@ class RepoBackend:
             # -- phase 4: slab dispatches + one clock executemany -------
             ready_ids: List[str] = []
             clock_rows: Dict[str, Dict[str, int]] = {}
+            self.last_bulk_stats = {
+                "docs": len(new_docs),
+                "fast": len(entries),
+                "fallback": len(fallback_docs),
+            }
             self._load_slabs(
                 entries, slab, pack_docs_columns, DecodedBatch,
                 decode_patch, ready_ids, clock_rows, pad_docs, pad_rows,
@@ -429,11 +434,6 @@ class RepoBackend:
                 self.clocks.update_many(self.id, clock_rows)
             for doc in fallback_docs:
                 self._load_document(doc)
-            self.last_bulk_stats = {
-                "docs": len(new_docs),
-                "fast": len(entries),
-                "fallback": len(fallback_docs),
-            }
             if fallback_docs:
                 log(
                     "repo:backend",
@@ -485,6 +485,25 @@ class RepoBackend:
         with ThreadPoolExecutor(max_workers=workers) as pool:
             list(pool.map(lambda a: a.columns(), actors))
 
+    def _mesh(self):
+        """The device mesh the bulk loader shards over, when >1 device is
+        visible (HM_MESH=0 forces single-device). Cached per backend."""
+        if getattr(self, "_mesh_cached", False):
+            return self._mesh_value
+        self._mesh_cached = True
+        self._mesh_value = None
+        if os.environ.get("HM_MESH", "1") != "0":
+            try:
+                import jax
+
+                if len(jax.devices()) > 1:
+                    from ..parallel.mesh import make_mesh
+
+                    self._mesh_value = make_mesh()
+            except Exception as e:  # no usable backend: host path only
+                log("repo:backend", f"no mesh: {e}")
+        return self._mesh_value
+
     def _load_slabs(
         self, entries, slab, pack_docs_columns, DecodedBatch,
         decode_patch, ready_ids, clock_rows, pad_docs=None, pad_rows=None,
@@ -510,7 +529,18 @@ class RepoBackend:
                 out = run_batch_host(batch)
                 summary = None
             else:
-                out, summary = run_batch_full(batch)  # async dispatch
+                mesh = self._mesh()
+                if mesh is not None:
+                    # multi-chip: THE same kernel, doc-sharded over dp
+                    # (parallel/sharded.py) — this is the v5e-8 path
+                    from ..parallel.sharded import sharded_full
+
+                    out, summary = sharded_full(batch, mesh)
+                    self.last_bulk_stats["sharded_slabs"] = (
+                        self.last_bulk_stats.get("sharded_slabs", 0) + 1
+                    )
+                else:
+                    out, summary = run_batch_full(batch)  # async dispatch
                 if os.environ.get("HM_ASYNC_SUMMARY_COPY", "1") != "0":
                     for leaf in summary:
                         # start the device->host copy now so the barrier

@@ -113,9 +113,13 @@ def test_graft_entry_single_chip():
     assert out.rank.shape[0] == 8
 
 
-def test_graft_dryrun_multichip():
+def test_graft_dryrun_multichip(monkeypatch):
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as g
 
+    # small corpus in CI; the driver runs the slab-scale default
+    monkeypatch.setenv("HM_DRYRUN_DOCS", "64")
+    monkeypatch.setenv("HM_DRYRUN_OPS", "96")
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")  # force device slabs
     g.dryrun_multichip(8)
     g.dryrun_multichip(4)
