@@ -179,17 +179,23 @@ class RepoBackend:
             self.docs.pop(doc_id, None)
 
     def destroy(self, doc_id: str) -> None:
-        """Remove doc state from stores (the reference stubs this out —
-        src/RepoBackend.ts:632-635; we do the real cleanup)."""
+        """Remove ALL doc state: store rows AND the on-disk feeds
+        (block logs, columnar sidecars, signature records) of every
+        actor exclusive to this doc. Actors shared with other docs keep
+        their feeds. (The reference stubs destroy out —
+        src/RepoBackend.ts:632-635; here it reclaims disk for real.)"""
         self.close_doc(doc_id)
-        self.db.execute(
-            "DELETE FROM clocks WHERE repo_id=? AND doc_id=?",
-            (self.id, doc_id),
-        )
-        self.db.execute(
-            "DELETE FROM cursors WHERE repo_id=? AND doc_id=?",
-            (self.id, doc_id),
-        )
+        actors = list(self.cursors.get(self.id, doc_id))
+        self.clocks.delete_doc(doc_id)  # peers' rows included
+        self.cursors.delete_doc(self.id, doc_id)
+        for actor_id in actors:
+            others = self.cursors.docs_with_actor(self.id, actor_id)
+            if others:  # shared with surviving docs: keep the feed
+                continue
+            with self._lock:
+                self.actors.pop(actor_id, None)
+            self.feed_info.remove(actor_id)
+            self.feeds.remove(actor_id)
 
     def handle_request(self, doc_id: str, request_json: Dict) -> None:
         doc = self.docs.get(doc_id)

@@ -57,8 +57,13 @@ class Repo:
     ) -> None:
         self.front.change(url, fn, message)
 
-    def merge(self, url: str, target: str) -> None:
-        self.front.merge(url, target)
+    def merge(
+        self, url: str, target: str, timeout: Optional[float] = 30.0
+    ) -> None:
+        """Adopt `target`'s actors/clock into `url`. If the target is an
+        unknown doc that never becomes ready, the pending merge expires
+        after `timeout` seconds (logged; pass None to wait forever)."""
+        self.front.merge(url, target, timeout=timeout)
 
     def fork(self, url: str) -> DocUrl:
         return self.front.fork(url)

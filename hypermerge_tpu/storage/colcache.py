@@ -190,6 +190,9 @@ class MemoryColumnStorage:
         self.tables.clear()
         self.commits.clear()
 
+    def destroy(self) -> None:
+        self.reset()
+
     def close(self) -> None:
         pass
 
@@ -355,6 +358,15 @@ class FileColumnStorage:
             if os.path.exists(p):
                 os.remove(p)
         self._n_rows = self._n_preds = self._n_tables_written = None
+
+    def destroy(self) -> None:
+        """reset + remove the sidecar directory itself (doc destroy)."""
+        self.reset()
+        try:
+            os.rmdir(self.path)
+        except OSError:
+            pass
+        self._dir_ready = False
 
     def close(self) -> None:
         if self._fhs is not None:
@@ -659,6 +671,13 @@ class FeedColumnCache:
                 row_ends=row_ends,
             )
             return self._cached
+
+    def destroy(self) -> None:
+        """Delete the cache's persisted state entirely (doc destroy)."""
+        with self._lock:
+            self.reset()
+            if hasattr(self._storage, "destroy"):
+                self._storage.destroy()
 
     def close(self) -> None:
         self._storage.close()

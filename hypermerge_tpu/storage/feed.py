@@ -40,6 +40,9 @@ class MemoryFeedStorage:
     def __len__(self) -> int:
         return len(self.blocks)
 
+    def destroy(self) -> None:
+        self.blocks.clear()
+
     def close(self) -> None:  # pragma: no cover - nothing to do
         pass
 
@@ -108,6 +111,15 @@ class FileFeedStorage:
     def __len__(self) -> int:
         self._ensure_scan()
         return len(self._offsets)
+
+    def destroy(self) -> None:
+        """Remove the block log from disk (doc destroy)."""
+        if os.path.exists(self.path):
+            os.remove(self.path)
+        self._offsets = []
+        self._sizes = []
+        self._end = 0
+        self._scanned = True
 
     def close(self) -> None:
         pass
@@ -270,6 +282,19 @@ class Feed:
         with self._lock:
             self._extend_listeners.append(cb)
 
+    def destroy(self) -> None:
+        """Delete everything this feed persisted: block log, columnar
+        sidecar, signature records."""
+        with self._lock:
+            if self.colcache is not None:
+                self.colcache.destroy()
+                self.colcache.close()
+            if self.integrity is not None:
+                self.integrity.destroy()
+            if hasattr(self._storage, "destroy"):
+                self._storage.destroy()
+            self._storage.close()
+
     def close(self) -> None:
         if self.colcache is not None:
             self.colcache.close()
@@ -383,6 +408,40 @@ class FeedStore:
     def head(self, public_key: str) -> bytes:
         feed = self._feeds[public_key]
         return feed.get(feed.length - 1)
+
+    def remove(self, public_key: str) -> None:
+        """Forget a feed and delete its persisted state (doc destroy) —
+        including state persisted by PREVIOUS sessions for feeds never
+        opened in this one."""
+        with self._lock:
+            feed = self._feeds.pop(public_key, None)
+            if feed is not None:
+                self._discovery_pending = [
+                    f for f in self._discovery_pending if f is not feed
+                ]
+                self._by_discovery = {
+                    d: pk
+                    for d, pk in self._by_discovery.items()
+                    if pk != public_key
+                }
+        if feed is not None:
+            feed.destroy()
+            return
+        # not open this session: destroy the on-disk state directly,
+        # without registering/announcing a transient feed
+        storage = self._storage_fn(public_key)
+        if hasattr(storage, "destroy"):
+            storage.destroy()
+        storage.close()
+        if self._cache_fn is not None:
+            from .colcache import FeedColumnCache
+
+            cc = FeedColumnCache(self._cache_fn(public_key), public_key)
+            cc.destroy()
+            cc.close()
+        from .integrity import FeedIntegrity
+
+        FeedIntegrity(self._sig_fn(public_key), public_key).destroy()
 
     def close(self) -> None:
         with self._lock:

@@ -105,6 +105,9 @@ class MemorySigStorage:
     def load(self) -> List[Tuple[int, bytes, bytes]]:
         return list(self.records)
 
+    def destroy(self) -> None:
+        self.records.clear()
+
     def close(self) -> None:  # pragma: no cover - nothing to do
         pass
 
@@ -129,6 +132,10 @@ class FileSigStorage:
         return [
             _REC.unpack_from(raw, i * _REC.size) for i in range(n)
         ]
+
+    def destroy(self) -> None:
+        if os.path.exists(self.path):
+            os.remove(self.path)
 
     def close(self) -> None:  # pragma: no cover - nothing to do
         pass
@@ -276,6 +283,14 @@ class FeedIntegrity:
             self._store.append(length, root, sig)
 
     # -- disk audit ---------------------------------------------------------
+
+    def destroy(self) -> None:
+        """Drop all records + cached state (doc destroy)."""
+        with self._lock:
+            self._store.destroy()
+            self._records = []
+            self._peaks = None
+            self._leaves = []
 
     def audit(self, feed) -> bool:
         """Re-hash the entire block log against the newest stored record.

@@ -106,6 +106,10 @@ class ClockStore:
             [(repo_id, doc_id, a, _clamp(s)) for a, s in clock.items()],
         )
 
+    def delete_doc(self, doc_id: str) -> None:
+        """Drop every repo's clock rows for a doc (doc destroy)."""
+        self.db.execute("DELETE FROM clocks WHERE doc_id=?", (doc_id,))
+
     def all_doc_ids(self, repo_id: str) -> List[str]:
         return [
             r[0]
@@ -254,6 +258,12 @@ class CursorStore:
     def actors_for(self, repo_id: str, doc_id: str) -> List[str]:
         return list(self.get(repo_id, doc_id).keys())
 
+    def delete_doc(self, repo_id: str, doc_id: str) -> None:
+        self.db.execute(
+            "DELETE FROM cursors WHERE repo_id=? AND doc_id=?",
+            (repo_id, doc_id),
+        )
+
 
 class KeyStore:
     def __init__(self, db: SqlDatabase) -> None:
@@ -316,6 +326,11 @@ class FeedInfoStore:
             (discovery_id,),
         )
         return rows[0][0] if rows else None
+
+    def remove(self, public_id: str) -> None:
+        self.db.execute(
+            "DELETE FROM feeds WHERE public_id=?", (public_id,)
+        )
 
     def is_writable(self, public_id: str) -> bool:
         rows = self.db.query(

@@ -1,0 +1,135 @@
+"""The CLI tools and chat example must actually run (the reference's
+tools/examples rotted against old APIs — SURVEY §1.7; ours are driven
+in CI)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from hypermerge_tpu.repo import Repo
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "PYTHONPATH": REPO_ROOT,
+}
+
+
+def _run(args, **kw):
+    return subprocess.run(
+        [sys.executable, *args],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=ENV,
+        cwd=REPO_ROOT,
+        **kw,
+    )
+
+
+def test_ls_and_watch_once(tmp_path):
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"title": "doc one", "n": 1})
+    repo.change(url, lambda d: d.__setitem__("n", 2))
+    repo.close()
+
+    out = _run(["tools/ls.py", path, "--audit"])
+    assert out.returncode == 0, out.stderr
+    assert url in out.stdout
+    assert "integrity=OK" in out.stdout
+
+    out = _run(["tools/watch.py", path, url, "--once"])
+    assert out.returncode == 0, out.stderr
+    state = json.loads(out.stdout.strip().splitlines()[-1])
+    assert state["doc"]["n"] == 2
+
+
+def _line_reader(stream):
+    """Background reader so a silent process can't block the test past
+    its deadline (readline would otherwise hang forever)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue()
+
+    def pump():
+        for line in stream:
+            q.put(line)
+        q.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
+
+    def next_line(timeout):
+        import queue as _q
+
+        try:
+            return q.get(timeout=timeout)
+        except _q.Empty:
+            return None
+
+    return next_line
+
+
+def test_chat_example_end_to_end(tmp_path):
+    """serve + join over real TCP; bob's message reaches alice."""
+    serve = subprocess.Popen(
+        [sys.executable, "examples/chat/chat.py", "serve", "--port", "0",
+         "--name", "alice"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=ENV,
+        cwd=REPO_ROOT,
+    )
+    try:
+        read_serve = _line_reader(serve.stdout)
+        url = None
+        addr = None
+        deadline = time.time() + 60
+        while time.time() < deadline and (url is None or addr is None):
+            line = read_serve(timeout=1.0)
+            if line is None:
+                continue
+            if line.startswith("channel: "):
+                url = line.split(" ", 1)[1].strip()
+            elif line.startswith("peers join with: "):
+                addr = line.split(": ", 1)[1].split(" ")[0].strip()
+        assert url and addr, "serve did not announce"
+
+        join = subprocess.Popen(
+            [sys.executable, "examples/chat/chat.py", "join", addr, url,
+             "--name", "bob"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=ENV,
+            cwd=REPO_ROOT,
+        )
+        try:
+            join.stdin.write("hello from bob\n")
+            join.stdin.flush()
+            got = []
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                line = read_serve(timeout=1.0)
+                if line is None:
+                    continue
+                got.append(line)
+                if "hello from bob" in line:
+                    break
+            assert any("hello from bob" in l for l in got), got
+        finally:
+            join.stdin.close()
+            join.wait(timeout=30)
+    finally:
+        serve.stdin.close()
+        try:
+            serve.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            serve.kill()

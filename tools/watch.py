@@ -1,0 +1,61 @@
+"""Watch a document: print its materialized state as JSON on every
+change (reference tools/Watch.ts:31-34).
+
+    python tools/watch.py /path/to/repo 'hypermerge:/<docId>'
+    python tools/watch.py /path/to/repo 'hypermerge:/<docId>' \
+        --connect HOST:PORT        # also join a peer and watch live
+"""
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from hypermerge_tpu.models.plain import to_plain as _plain  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", help="repo directory")
+    ap.add_argument("url", help="doc url to watch")
+    ap.add_argument("--connect", help="HOST:PORT of a peer to join")
+    ap.add_argument(
+        "--once", action="store_true", help="print current state and exit"
+    )
+    args = ap.parse_args()
+
+    repo = Repo(path=args.repo)
+    if args.connect:
+        from hypermerge_tpu.net.tcp import TcpSwarm
+
+        swarm = TcpSwarm()
+        repo.set_swarm(swarm)
+        host, _, port = args.connect.partition(":")
+        swarm.connect((host, int(port)))
+
+    def show(doc, index):
+        print(
+            json.dumps(
+                {"history": index, "doc": _plain(doc)}, default=str
+            ),
+            flush=True,
+        )
+
+    if args.once:
+        show(repo.doc(args.url), -1)
+        repo.close()
+        return
+    repo.watch(args.url, show)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        repo.close()
+
+
+if __name__ == "__main__":
+    main()
