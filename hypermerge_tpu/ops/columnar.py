@@ -529,19 +529,40 @@ def _try_pack_prefix_single(
         "ref": ref_row, "insert": R[:, 9], "vkind": vkind,
         "value": value_g, "dt": R[:, 12],
     }
+    # allocate the device wire dtypes directly (host_args then passes
+    # them through copy-free): everything row-indexed fits int16 when
+    # N < 32k — the common case — and flags planes fit uint8
+    i16ok = N < 2**15
+    vmin = int(value_g.min(initial=0))
+    vmax = int(value_g.max(initial=0))
+    dtypes = {
+        "action": np.uint8,
+        "insert": np.uint8,
+        "vkind": np.uint8,
+        "dt": np.uint8,
+        "actor": np.int32,  # batch-global ids (host/decode only)
+        "ctr": np.int16 if i16ok else np.int32,
+        "seq": np.int16 if i16ok else np.int32,
+        "obj": np.int16 if i16ok else np.int32,
+        "key": np.int16 if len(key_int.items) < 2**15 else np.int32,
+        "ref": np.int16 if i16ok else np.int32,
+        "value": (
+            np.int16
+            if i16ok and -(2**15) <= vmin and vmax < 2**15
+            else np.int32
+        ),
+    }
     for name in COLUMNS:
-        flat = np.full(Dp * N, defaults.get(name, 0), np.int32)
-        src = sources[name]
-        flat[flat_idx] = src if src.dtype == np.int32 else src.astype(
-            np.int32
-        )
+        flat = np.full(Dp * N, defaults.get(name, 0), dtypes[name])
+        flat[flat_idx] = sources[name]
         cols[name] = flat.reshape(Dp, N)
-    psrc = np.full(Dp * P, -1, np.int32)
-    ptgt = np.full(Dp * P, -1, np.int32)
+    pdt = np.int16 if i16ok else np.int32
+    psrc = np.full(Dp * P, -1, pdt)
+    ptgt = np.full(Dp * P, -1, pdt)
     if len(p_src_row):
         pidx = pr_doc * P + p_pos
-        psrc[pidx] = p_src_row.astype(np.int32)
-        ptgt[pidx] = p_tgt_row.astype(np.int32)
+        psrc[pidx] = p_src_row
+        ptgt[pidx] = p_tgt_row
 
     doc_actors = np.full((Dp, 1), -1, np.int32)
     doc_actors[:D, 0] = writer_g.astype(np.int32)[fc_idx_a]
@@ -559,7 +580,7 @@ def _try_pack_prefix_single(
         bigints=list(big_int.items),
         doc_actors=doc_actors,
     )
-    batch.slot = np.zeros((Dp, N), np.int16)  # single writer: slot 0
+    batch.slot = np.zeros((Dp, N), np.int8)  # single writer: slot 0
     return batch
 
 

@@ -447,7 +447,8 @@ def host_args(batch: ColumnarBatch):
     _check_ranges(batch, A, K)
     N = batch.n_rows
     flags = (
-        c["action"].astype(np.uint8) | (c["insert"].astype(np.uint8) << 3)
+        np.asarray(c["action"], np.uint8)
+        | (np.asarray(c["insert"], np.uint8) << 3)
     )
     vmax = int(c["value"].max(initial=0))
     vmin = int(c["value"].min(initial=0))

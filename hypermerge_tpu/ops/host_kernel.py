@@ -180,12 +180,16 @@ def run_batch_host(batch: ColumnarBatch) -> HostOut:
     from .crdt_kernels import bucket_doc_actors
 
     da, A, K = bucket_doc_actors(batch)
-    c = batch.cols
+    # widen: batches may carry narrow wire dtypes (int16/uint8) whose
+    # composites (ctr * A) would overflow in-place
+    c = {k: np.asarray(v, np.int32) for k, v in batch.cols.items()}
+    psrc = np.asarray(batch.psrc, np.int32)
+    ptgt = np.asarray(batch.ptgt, np.int32)
     outs = [
         _host_doc_kernel(
             c["action"][d], c["actor"][d], c["ctr"][d], c["seq"][d],
             c["obj"][d], c["key"][d], c["ref"][d], c["insert"][d],
-            c["value"][d], batch.psrc[d], batch.ptgt[d], da[d], A, K,
+            c["value"][d], psrc[d], ptgt[d], da[d], A, K,
         )
         for d in range(batch.n_docs)
     ]
