@@ -37,12 +37,13 @@ replicating the feed.
 from __future__ import annotations
 
 import base64
+import hmac
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
 from ..storage.feed import Feed, FeedStore
-from ..storage.integrity import allow_unsigned
+from ..storage.integrity import allow_unsigned, capability
 from ..utils.debug import log
 from ..utils.mapset import MapSet
 from .peer import NetworkPeer
@@ -69,26 +70,52 @@ class ReplicationManager:
         self._on_discovery = on_discovery
         self._lock = threading.RLock()
         self._peers: Set[NetworkPeer] = set()
-        # discovery_id -> peers replicating it with us
+        # discovery_id -> peers replicating it with us. Membership
+        # requires CAPABILITY verification: a peer only enters (and so
+        # only ever receives blocks/tails/gossip for the feed) after
+        # proving knowledge of the feed public key — learning a
+        # discovery id from announcements must not unlock data
+        # (hypercore-protocol's capability check).
         self._replicating: MapSet = MapSet()
+        self._verified: MapSet = MapSet()  # did -> peers that proved
         self._tailed: Set[str] = set()  # feeds we attached appenders to
+        # per-connection random capability challenges: ours (what peers
+        # must prove against) and theirs (what we prove against)
+        self._challenge_local: Dict[NetworkPeer, bytes] = {}
+        self._challenge_remote: Dict[NetworkPeer, bytes] = {}
 
     # ------------------------------------------------------------------
+
+    def _challenge_for(self, peer: NetworkPeer) -> bytes:
+        with self._lock:
+            c = self._challenge_local.get(peer)
+            if c is None:
+                c = os.urandom(32)
+                self._challenge_local[peer] = c
+            return c
 
     def on_peer(self, peer: NetworkPeer) -> None:
         with self._lock:
             self._peers.add(peer)
         ch = peer.connection.open_channel(CHANNEL)
         ch.subscribe(lambda msg: self._on_message(peer, msg))
-        ch.send(
-            {"type": "DiscoveryIds", "ids": self.feeds.known_discovery_ids()}
-        )
+        ch.send({
+            "type": "DiscoveryIds",
+            "ids": self.feeds.known_discovery_ids(),
+            "challenge": base64.b64encode(
+                self._challenge_for(peer)
+            ).decode("ascii"),
+        })
 
     def on_peer_closed(self, peer: NetworkPeer) -> None:
         with self._lock:
             self._peers.discard(peer)
             for did in self._replicating.keys_with(peer):
                 self._replicating.remove(did, peer)
+            for did in self._verified.keys_with(peer):
+                self._verified.remove(did, peer)
+            self._challenge_local.pop(peer, None)
+            self._challenge_remote.pop(peer, None)
 
     def announce(self, feed: Feed) -> None:
         """A newly created/opened feed: tell every connected peer
@@ -98,9 +125,13 @@ class ReplicationManager:
             peers = list(self._peers)
         for peer in peers:
             if peer.is_connected:
-                peer.connection.open_channel(CHANNEL).send(
-                    {"type": "DiscoveryIds", "ids": [feed.discovery_id]}
-                )
+                peer.connection.open_channel(CHANNEL).send({
+                    "type": "DiscoveryIds",
+                    "ids": [feed.discovery_id],
+                    "challenge": base64.b64encode(
+                        self._challenge_for(peer)
+                    ).decode("ascii"),
+                })
 
     def peers_with_feed(self, discovery_id: str) -> List[NetworkPeer]:
         with self._lock:
@@ -117,11 +148,20 @@ class ReplicationManager:
         try:
             t = msg.get("type")
             if t == "DiscoveryIds":
+                if "challenge" in msg:
+                    with self._lock:
+                        self._challenge_remote[peer] = base64.b64decode(
+                            msg["challenge"]
+                        )
                 self._on_discovery_ids(peer, list(msg["ids"]))
             elif t == "FeedLength":
-                self._on_feed_length(peer, msg["id"], int(msg["length"]))
+                self._on_feed_length(
+                    peer, msg["id"], int(msg["length"]), msg.get("cap")
+                )
             elif t == "Request":
-                self._on_request(peer, msg["id"], int(msg["from"]))
+                self._on_request(
+                    peer, msg["id"], int(msg["from"]), msg.get("cap")
+                )
             elif t == "Blocks":
                 self._on_blocks(
                     peer,
@@ -135,46 +175,94 @@ class ReplicationManager:
         except (KeyError, TypeError, ValueError) as e:
             log("replication", f"malformed msg from {peer.id[:6]}: {e}")
 
-    def _start_replicating(
-        self, peer: NetworkPeer, feed: Feed, announce_length: bool
+    def _feed_length_msg(
+        self, feed: Feed, peer: NetworkPeer, conceal: bool = False
+    ) -> Optional[Dict]:
+        """Our proof + length for a peer. `conceal` hides the real
+        length from peers that haven't proven key knowledge yet (feed
+        size is metadata the capability gates too). None when the peer's
+        challenge hasn't arrived (its DiscoveryIds opener is in flight —
+        the exchange resumes off their reply)."""
+        with self._lock:
+            challenge = self._challenge_remote.get(peer)
+        if challenge is None:
+            return None
+        return {
+            "type": "FeedLength",
+            "id": feed.discovery_id,
+            "length": 0 if conceal else feed.length,
+            "cap": capability(feed.public_key, challenge),
+        }
+
+    def _request_msg(
+        self, feed: Feed, peer: NetworkPeer, start: int
+    ) -> Optional[Dict]:
+        with self._lock:
+            challenge = self._challenge_remote.get(peer)
+        if challenge is None:
+            return None
+        return {
+            "type": "Request",
+            "id": feed.discovery_id,
+            "from": start,
+            "cap": capability(feed.public_key, challenge),
+        }
+
+    def _check_cap(
+        self, peer: NetworkPeer, feed: Feed, cap
     ) -> bool:
-        """First association of (feed, peer): tail the feed, optionally
-        announce our length, and fire the Discovery event. Returns True
-        if this was the first association."""
-        newly = self._replicating.add(feed.discovery_id, peer)
+        """Verify the sender's capability proof against OUR random
+        per-connection challenge; on first success mark the peer
+        replication-eligible for the feed (and reply with our own proof
+        so both directions activate). Returns eligibility."""
+        want = capability(feed.public_key, self._challenge_for(peer))
+        if not isinstance(cap, str) or not hmac.compare_digest(cap, want):
+            log(
+                "replication",
+                f"capability check FAILED for {feed.public_key[:6]} "
+                f"from {peer.id[:6]}: withholding blocks",
+            )
+            return False
+        newly = self._verified.add(feed.discovery_id, peer)
         if newly:
+            self._replicating.add(feed.discovery_id, peer)
             self._tail(feed)
-            if announce_length:
-                self._send(peer, {
-                    "type": "FeedLength",
-                    "id": feed.discovery_id,
-                    "length": feed.length,
-                })
             self._on_discovery(feed.public_key, peer)
-        return newly
+            # prove ourselves back so the peer activates us too (the
+            # exchange terminates: replies only fire on FIRST proof)
+            reply = self._feed_length_msg(feed, peer)
+            if reply is not None:
+                self._send(peer, reply)
+        return True
 
     def _on_discovery_ids(self, peer: NetworkPeer, ids: List[str]) -> None:
         for did in ids:
             feed = self.feeds.by_discovery_id(did)
             if feed is None:
                 continue  # we don't know this feed's key — can't replicate
-            self._start_replicating(peer, feed, announce_length=True)
+            self._tail(feed)
+            # announce with our capability proof but CONCEAL the length:
+            # the peer gets data (and metadata) only after proving its own
+            msg = self._feed_length_msg(feed, peer, conceal=True)
+            if msg is not None:
+                self._send(peer, msg)
 
     def _on_feed_length(
-        self, peer: NetworkPeer, did: str, their_len: int
+        self, peer: NetworkPeer, did: str, their_len: int, cap
     ) -> None:
         feed = self.feeds.by_discovery_id(did)
         if feed is None:
             return
-        self._start_replicating(peer, feed, announce_length=False)
+        if not self._check_cap(peer, feed, cap):
+            return
         if feed.length < their_len:
-            self._send(peer, {
-                "type": "Request", "id": did, "from": feed.length,
-            })
+            msg = self._request_msg(feed, peer, feed.length)
         elif feed.length > their_len:
-            self._send(peer, {
-                "type": "FeedLength", "id": did, "length": feed.length,
-            })
+            msg = self._feed_length_msg(feed, peer)
+        else:
+            return
+        if msg is not None:
+            self._send(peer, msg)
 
     def _pick_boundary(self, feed: Feed, start: int) -> int:
         """End of the next backfill chunk, bounded in BLOCKS and BYTES
@@ -232,9 +320,15 @@ class ReplicationManager:
             "total": feed.length,
         }
 
-    def _on_request(self, peer: NetworkPeer, did: str, start: int) -> None:
+    def _on_request(
+        self, peer: NetworkPeer, did: str, start: int, cap
+    ) -> None:
         feed = self.feeds.by_discovery_id(did)
-        if feed is None or start >= feed.length:
+        if feed is None:
+            return
+        if not self._check_cap(peer, feed, cap):
+            return  # no key knowledge proven: no data
+        if start >= feed.length:
             return
         end = self._pick_boundary(feed, start)
         self._send(peer, self._blocks_msg(feed, did, start, end))
@@ -254,9 +348,9 @@ class ReplicationManager:
             return
         if start > feed.length:
             # gap: re-request from our actual head
-            self._send(peer, {
-                "type": "Request", "id": did, "from": feed.length,
-            })
+            msg = self._request_msg(feed, peer, feed.length)
+            if msg is not None:
+                self._send(peer, msg)
             return
         raw = [base64.b64decode(b) for b in blocks]
         if sig_b64 is not None and length >= 0:
@@ -287,9 +381,9 @@ class ReplicationManager:
             return
         if total > feed.length:
             # ack-paced stream: pull the next chunk
-            self._send(peer, {
-                "type": "Request", "id": did, "from": feed.length,
-            })
+            msg = self._request_msg(feed, peer, feed.length)
+            if msg is not None:
+                self._send(peer, msg)
 
     def _tail(self, feed: Feed) -> None:
         with self._lock:

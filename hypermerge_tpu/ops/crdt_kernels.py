@@ -187,15 +187,22 @@ def _doc_kernel(
         .set(jnp.where(same_parent, nxt_in_sort, -1).astype(jnp.int32))
     )
 
-    # climb-to-sibling fixpoint via pointer doubling (terminal = N)
+    # climb-to-sibling fixpoint via pointer doubling (terminal = N);
+    # int16 payload when it fits — gathers move half the bytes
     has_sib = nsib != -1
     jump = jnp.where(
         has_sib, idx, jnp.where(parent >= 0, parent, N)
     ).astype(jnp.int32)
     jump = jnp.where(in_forest, jump, N)
     jump_ext = jnp.concatenate([jump, jnp.array([N], jnp.int32)])
-    for _ in range(_ceil_log2(N) + 1):
-        jump_ext = jump_ext[jump_ext]
+    if N < 2**15:
+        j16 = jump_ext.astype(jnp.int16)
+        for _ in range(_ceil_log2(N) + 1):
+            j16 = j16[j16.astype(jnp.int32)]
+        jump_ext = j16.astype(jnp.int32)
+    else:
+        for _ in range(_ceil_log2(N) + 1):
+            jump_ext = jump_ext[jump_ext]
     fix = jump_ext[:N]
     nsib_ext = jnp.concatenate([nsib, jnp.array([-1], jnp.int32)])
     succ = jnp.where(first_child != -1, first_child, nsib_ext[fix])

@@ -336,3 +336,23 @@ def sign_chain(blocks: List[bytes], seed: bytes) -> bytes:
 
 def allow_unsigned() -> bool:
     return os.environ.get("HM_ALLOW_UNSIGNED_FEEDS") == "1"
+
+
+def capability(public_key: str, challenge: bytes) -> str:
+    """Proof of feed-key knowledge for the replication protocol
+    (hypercore-protocol's capability verification, reference
+    src/types/hypercore-protocol.d.ts:62-106): a keyed hash only a
+    holder of the feed PUBLIC key can compute — discovery ids alone
+    (which peers learn from announcements) must not unlock block data.
+    Bound to the VERIFIER's per-connection random challenge, so a proof
+    captured on one connection (or handed to an impersonator) is
+    worthless on any other."""
+    import hashlib
+
+    return keymod.encode(
+        hashlib.blake2b(
+            b"hm-cap:" + challenge,
+            key=keymod.decode(public_key),
+            digest_size=32,
+        ).digest()
+    )

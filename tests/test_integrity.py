@@ -209,6 +209,83 @@ class TestReplicationVerification:
         )
         assert fb.read_all() == [b"real"]
 
+    def test_discovery_id_alone_cannot_fetch_blocks(self):
+        """Capability verification (hypercore-protocol parity): a peer
+        that learned a feed's discovery id from announcements but does
+        NOT know the feed public key gets no data — its Requests carry
+        no valid key-derived capability."""
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        fa.append(b"secret-block")
+        pa, pb = _connect(mgr_a, mgr_b)  # b shares NO feeds with a
+
+        # attacker on b's side: craft Requests with the announced did;
+        # spy on everything b's manager receives back
+        got = []
+        orig = mgr_b._on_message
+        mgr_b._on_message = lambda peer, msg: (
+            got.append(msg), orig(peer, msg)
+        )
+        ch = pb.connection.open_channel("Replication")
+        did = fa.discovery_id
+        ch.send({"type": "Request", "id": did, "from": 0, "cap": "bogus"})
+        ch.send({"type": "Request", "id": did, "from": 0})
+        assert not any(
+            m.get("type") == "Blocks" for m in got if isinstance(m, dict)
+        ), got
+
+        # whereas a peer proving the capability (key + A's challenge)
+        # does get data
+        from hypermerge_tpu.storage.integrity import capability
+
+        challenge = mgr_a._challenge_local[pa]
+        ch.send({
+            "type": "Request", "id": did, "from": 0,
+            "cap": capability(pair.public_key, challenge),
+        })
+        assert any(
+            m.get("type") == "Blocks" for m in got if isinstance(m, dict)
+        ), got
+
+    def test_capability_not_replayable_across_connections(self):
+        """A cap captured on one connection is useless on another: proofs
+        bind to the verifier's per-connection random challenge — an
+        impersonator armed with a stolen proof still gets nothing."""
+        from hypermerge_tpu.storage.integrity import capability
+
+        feeds_a, mgr_a, _ = _mgr()
+        feeds_b, mgr_b, _ = _mgr()
+        feeds_c, mgr_c, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        fa.append(b"data")
+        fb = feeds_b.open_feed(pair.public_key)
+        pa, _pb = _connect(mgr_a, mgr_b)
+        assert fb.read_all() == [b"data"]  # legit sync worked
+
+        # the cap B proved with on the a<->b connection (bound to the
+        # challenge A issued there)
+        stale_cap = capability(
+            pair.public_key, mgr_a._challenge_local[pa]
+        )
+        # attacker C (knows only the discovery id) replays it on a<->c
+        _pca, pcc = _connect(mgr_a, mgr_c)
+        got = []
+        orig = mgr_c._on_message
+        mgr_c._on_message = lambda peer, msg: (
+            got.append(msg), orig(peer, msg)
+        )
+        ch = pcc.connection.open_channel("Replication")
+        ch.send({
+            "type": "Request", "id": fa.discovery_id, "from": 0,
+            "cap": stale_cap,
+        })
+        assert not any(
+            m.get("type") == "Blocks" for m in got if isinstance(m, dict)
+        ), got
+
     def test_unsigned_blocks_dropped_by_default(self):
         feeds_b, mgr_b, _ = _mgr()
         pair = keymod.create()
