@@ -82,8 +82,12 @@ def _write_doc(
     for b in blocks:
         parts.append(_HDR.pack(len(b)))
         parts.append(b)
+    log_bytes = b"".join(parts)
     with open(os.path.join(d, pk), "wb") as fh:
-        fh.write(b"".join(parts))
+        fh.write(log_bytes)
+    # block-count index (storage/feed.py FileFeedStorage._LEN)
+    with open(os.path.join(d, pk + ".len"), "wb") as fh:
+        fh.write(struct.pack("<QQ", len(blocks), len(log_bytes)))
     if sign:
         with open(os.path.join(d, pk + ".sig"), "wb") as fh:
             fh.write(sign_chain(blocks, keymod.decode(pair.secret_key)))

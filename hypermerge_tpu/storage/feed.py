@@ -48,28 +48,66 @@ class MemoryFeedStorage:
 
 
 class FileFeedStorage:
-    """Length-prefixed block log + in-memory offset index.
+    """Length-prefixed block log + block-count index sidecar.
 
     Crash-safety model matches the reference's append-only philosophy
     (SURVEY.md §5 failure detection): a torn tail write is detected by the
     length prefix running past EOF and the tail is ignored — the same
     self-healing the reference applies to holey feeds
-    (reference src/hypercore.ts:39-47)."""
+    (reference src/hypercore.ts:39-47).
+
+    The `.len` sidecar holds (block_count, end_offset); when its end
+    offset matches the log's stat size, `len(storage)` is a stat call —
+    a bulk cold start with fresh columnar sidecars needs only the block
+    COUNT of ten thousand feeds (the sidecar-trust check), not their
+    bytes. Any mismatch (torn append, out-of-band edit) falls back to a
+    full scan. The per-block offset index is built lazily on first
+    `get`."""
 
     _HDR = struct.Struct("<I")
+    _LEN = struct.Struct("<QQ")  # block count, end offset
 
     def __init__(self, path: str) -> None:
         self.path = path
         self._offsets: List[int] = []
         self._sizes: List[int] = []
         self._end = 0
-        # scan is lazy and no FD is held: a bulk cold start touches tens
-        # of thousands of feeds (past any ulimit), and when the columnar
-        # sidecar is fresh the block log is never read at all — only its
-        # block *count*, which the lazy scan provides on first use
+        self._count: Optional[int] = None  # known count, offsets may lag
         self._scanned = not os.path.exists(path)
         if self._scanned:
             os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._count = 0
+
+    def _len_path(self) -> str:
+        return self.path + ".len"
+
+    def _write_len(self) -> None:
+        with open(self._len_path(), "wb") as fh:
+            fh.write(self._LEN.pack(self._count, self._end))
+
+    def _try_count_shortcut(self) -> bool:
+        """Trust the .len sidecar iff its end offset equals the log's
+        actual size."""
+        try:
+            with open(self._len_path(), "rb") as fh:
+                raw = fh.read(self._LEN.size)
+            if len(raw) != self._LEN.size:
+                return False
+            count, end = self._LEN.unpack(raw)
+            if os.path.getsize(self.path) != end:
+                return False  # torn append or external edit: rescan
+            self._count = count
+            self._end = end
+            return True
+        except OSError:
+            return False
+
+    def _ensure_count(self) -> None:
+        if self._count is not None:
+            return
+        if self._try_count_shortcut():
+            return
+        self._ensure_scan()
 
     def _ensure_scan(self) -> None:
         if self._scanned:
@@ -79,6 +117,8 @@ class FileFeedStorage:
             raw = fh.read()
         end = len(raw)
         pos = 0
+        self._offsets = []
+        self._sizes = []
         while pos + self._HDR.size <= end:
             (size,) = self._HDR.unpack_from(raw, pos)
             if pos + self._HDR.size + size > end:
@@ -87,6 +127,7 @@ class FileFeedStorage:
             self._sizes.append(size)
             pos += self._HDR.size + size
         self._end = pos
+        self._count = len(self._offsets)
 
     def append(self, data: bytes) -> None:
         self._ensure_scan()
@@ -101,6 +142,8 @@ class FileFeedStorage:
         self._offsets.append(self._end + self._HDR.size)
         self._sizes.append(len(data))
         self._end += self._HDR.size + len(data)
+        self._count = len(self._offsets)
+        self._write_len()
 
     def get(self, index: int) -> bytes:
         self._ensure_scan()
@@ -109,16 +152,18 @@ class FileFeedStorage:
             return fh.read(self._sizes[index])
 
     def __len__(self) -> int:
-        self._ensure_scan()
-        return len(self._offsets)
+        self._ensure_count()
+        return self._count
 
     def destroy(self) -> None:
-        """Remove the block log from disk (doc destroy)."""
-        if os.path.exists(self.path):
-            os.remove(self.path)
+        """Remove the block log (and its .len index) from disk."""
+        for p in (self.path, self._len_path()):
+            if os.path.exists(p):
+                os.remove(p)
         self._offsets = []
         self._sizes = []
         self._end = 0
+        self._count = 0
         self._scanned = True
 
     def close(self) -> None:
