@@ -531,6 +531,12 @@ class RepoBackend:
                 n_docs=pad_docs or round_up_pow2(len(chunk)),
                 n_rows=pad_rows,
             )
+            # host clocks (authoritative, from sidecar metadata) for
+            # every doc in the slab, padded docs empty — lets the device
+            # path skip the seq wire entirely
+            slab_clocks = [e[2] for e in chunk] + [{}] * (
+                batch.n_docs - len(chunk)
+            )
             if batch.n_docs * batch.n_rows < min_cells:
                 out = run_batch_host(batch)
                 summary = None
@@ -546,7 +552,15 @@ class RepoBackend:
                         self.last_bulk_stats.get("sharded_slabs", 0) + 1
                     )
                 else:
-                    out, summary = run_batch_full(batch)  # async dispatch
+                    from ..crdt.change import Action
+                    import numpy as np
+
+                    # no INC ops + host clocks in hand -> skip the seq
+                    # and value wires (~4 of 14 bytes/op on the tunnel)
+                    lean = not bool(
+                        np.any(batch.cols["action"] == int(Action.INC))
+                    )
+                    out, summary = run_batch_full(batch, lean=lean)
                 if os.environ.get("HM_ASYNC_SUMMARY_COPY", "1") != "0":
                     for leaf in summary:
                         # start the device->host copy now so the barrier
@@ -556,7 +570,7 @@ class RepoBackend:
                             leaf.copy_to_host_async()
                         except AttributeError:  # non-device backend
                             pass
-            dec = DecodedBatch(batch, out)
+            dec = DecodedBatch(batch, out, host_clocks=slab_clocks)
             self._pending_summaries.append(
                 ([e[0].id for e in chunk], batch, dec, summary)
             )

@@ -370,6 +370,26 @@ def materialize_full_device(
     return out, _summarize(out, flags.shape[1])
 
 
+@partial(jax.jit, static_argnames=("A", "K"))
+def materialize_full_lean_device(
+    flags, slot, ctr, obj, key, ref, psrc, ptgt, doc_actors,
+    A: int, K: int,
+):
+    """materialize_full_device minus the seq and value wires (~4 bytes/op
+    on a link where every byte is wall-clock). Correct ONLY when the
+    batch has no INC ops (value feeds counter accumulation) and the
+    caller supplies clocks host-side (seq feeds only the clock lane —
+    the bulk loader's clocks come from the sidecar metadata and are the
+    more authoritative value anyway). inc_total and clock lanes come
+    back as zeros."""
+    zeros = jnp.zeros_like(ctr)
+    out = batched_kernel(A, K)(
+        flags, slot, ctr, zeros, obj, key, ref, zeros, psrc, ptgt,
+        doc_actors,
+    )
+    return out, _summarize(out, flags.shape[1])
+
+
 def ensure_doc_actors(batch: ColumnarBatch):
     """batch.doc_actors, deriving it from the actor column when a legacy
     producer didn't supply one (cached back onto the batch)."""
@@ -479,11 +499,19 @@ def host_args(batch: ColumnarBatch):
     return args, A, K
 
 
-def _device_args(batch: ColumnarBatch):
-    """(device args, A_loc, K) for the jitted kernels."""
+_LEAN_SKIP = (3, 7)  # seq, value positions in the wire tuple
+
+
+def _device_args(batch: ColumnarBatch, lean: bool = False):
+    """(device args, A_loc, K) for the jitted kernels. `lean` skips the
+    seq/value uploads (their slots return None)."""
     _enable_persistent_compile_cache()
     np_args, A, K = host_args(batch)
-    return tuple(jnp.asarray(a) for a in np_args), A, K
+    args = tuple(
+        None if lean and i in _LEAN_SKIP else jnp.asarray(a)
+        for i, a in enumerate(np_args)
+    )
+    return args, A, K
 
 
 def run_batch_summary(batch: ColumnarBatch) -> SummaryOut:
@@ -498,9 +526,18 @@ def run_batch(batch: ColumnarBatch) -> MaterializeOut:
     return materialize_device(*args, A=A, K=K)
 
 
-def run_batch_full(batch: ColumnarBatch):
-    """Host entry -> (MaterializeOut, SummaryOut) in one dispatch."""
-    args, A, K = _device_args(batch)
+def run_batch_full(batch: ColumnarBatch, lean: bool = False):
+    """Host entry -> (MaterializeOut, SummaryOut) in one dispatch.
+
+    `lean=True` (callers that hold authoritative host clocks and verified
+    the batch carries no INC ops) skips the seq/value wires entirely."""
+    args, A, K = _device_args(batch, lean=lean)
+    if lean:
+        (flags, slot, ctr, _seq, obj, key, ref, _value, psrc, ptgt,
+         da) = args
+        return materialize_full_lean_device(
+            flags, slot, ctr, obj, key, ref, psrc, ptgt, da, A=A, K=K
+        )
     return materialize_full_device(*args, A=A, K=K)
 
 

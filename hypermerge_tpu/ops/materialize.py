@@ -50,10 +50,18 @@ class DecodedBatch:
         "rank", "inc_total", "clock",
     )
 
-    def __init__(self, batch: ColumnarBatch, out: MaterializeOut) -> None:
+    def __init__(
+        self,
+        batch: ColumnarBatch,
+        out: MaterializeOut,
+        host_clocks: Optional[List[Dict[str, int]]] = None,
+    ) -> None:
         self.batch = batch
         self.cols = {k: np.asarray(v) for k, v in batch.cols.items()}
         self._out = out
+        # authoritative per-doc clocks from the caller (lean kernel runs
+        # don't transfer the seq wire, so the device clock lane is zeros)
+        self.host_clocks = host_clocks
 
     def __getattr__(self, name: str):
         if name in DecodedBatch._LANES and "_out" in self.__dict__:
@@ -65,6 +73,8 @@ class DecodedBatch:
         raise AttributeError(name)
 
     def clock_dict(self, d: int) -> Dict[str, int]:
+        if self.host_clocks is not None:
+            return dict(self.host_clocks[d])
         return _local_clock_dict(
             self.batch, _doc_actors_row(self.batch, d), self.clock[d]
         )
@@ -83,7 +93,15 @@ class DecodedBatch:
                 ]
         cols = {k: v[d : d + 1] for k, v in self.cols.items()}
         return DocView(
-            self.batch, cols, lanes, _doc_actors_row(self.batch, d)
+            self.batch,
+            cols,
+            lanes,
+            _doc_actors_row(self.batch, d),
+            host_clock=(
+                dict(self.host_clocks[d])
+                if self.host_clocks is not None
+                else None
+            ),
         )
 
 
@@ -110,14 +128,19 @@ def _local_clock_dict(
 class DocView:
     """One document's rows/lanes, shaped [1, N] — decode_patch(view, 0)."""
 
-    def __init__(self, batch, cols, lanes, doc_actors) -> None:
+    def __init__(
+        self, batch, cols, lanes, doc_actors, host_clock=None
+    ) -> None:
         self.batch = batch
         self.cols = cols
         self.doc_actors = doc_actors
+        self.host_clock = host_clock
         for name, arr in lanes.items():
             setattr(self, name, arr)
 
     def clock_dict(self, _d: int) -> Dict[str, int]:
+        if self.host_clock is not None:
+            return dict(self.host_clock)
         return _local_clock_dict(self.batch, self.doc_actors, self.clock[0])
 
 
@@ -350,6 +373,7 @@ class BulkSummaries:
     def __init__(self, pending) -> None:
         # pending: (doc_ids, batch, dec, device_summary_or_None) per slab
         self.slabs: List[Tuple[List[str], ColumnarBatch, Dict]] = []
+        self._decs: List[DecodedBatch] = []
         self._where: Dict[str, Tuple[int, int]] = {}
         for doc_ids, batch, dec, summary in pending:
             arrays = (
@@ -358,6 +382,7 @@ class BulkSummaries:
                 else fetch_summary(summary, batch.n_rows)
             )
             self.slabs.append((doc_ids, batch, arrays))
+            self._decs.append(dec)
             for j, d in enumerate(doc_ids):
                 self._where[d] = (len(self.slabs) - 1, j)
 
@@ -373,12 +398,18 @@ class BulkSummaries:
     def doc(self, doc_id: str) -> Dict[str, Any]:
         si, j = self._where[doc_id]
         doc_ids, batch, arrays = self.slabs[si]
+        dec = self._decs[si]
+        clock = (
+            dict(dec.host_clocks[j])
+            if getattr(dec, "host_clocks", None) is not None
+            else _local_clock_dict(
+                batch, _doc_actors_row(batch, j), arrays["clock"][j]
+            )
+        )
         return {
             "elems": int(arrays["n_live_elems"][j]),
             "map_entries": int(arrays["n_map_entries"][j]),
-            "clock": _local_clock_dict(
-                batch, _doc_actors_row(batch, j), arrays["clock"][j]
-            ),
+            "clock": clock,
         }
 
 
