@@ -460,12 +460,15 @@ def _narrow(arr, lo: int, hi: int):
     return np.ascontiguousarray(arr, dtype=np.int32)
 
 
-def host_args(batch: ColumnarBatch):
+def host_args(batch: ColumnarBatch, lean: bool = False):
     """(numpy wire args, A_loc, K): the narrow columns every kernel entry
     transfers. uint8 flags = action|insert<<3; int8 slot; int16 where the
     value range fits (N-indexed columns whenever N < 32k — the common
     case), int32 otherwise. Dtypes are a function of the (N, P) bucket
-    and value ranges, so slabs of one bulk load share one executable."""
+    and value ranges, so slabs of one bulk load share one executable.
+    `lean` leaves the seq/value slots as None — their narrowing passes
+    (two [D, N] copies + range scans) are skipped, not just their
+    uploads."""
     import numpy as np
 
     da, A, K = bucket_doc_actors(batch)
@@ -477,21 +480,26 @@ def host_args(batch: ColumnarBatch):
         np.asarray(c["action"], np.uint8)
         | (np.asarray(c["insert"], np.uint8) << 3)
     )
-    vmax = int(c["value"].max(initial=0))
-    vmin = int(c["value"].min(initial=0))
     cmax = int(c["ctr"].max(initial=0))
-    smax = int(c["seq"].max(initial=0))
+    if lean:
+        seq_w = value_w = None
+    else:
+        vmax = int(c["value"].max(initial=0))
+        vmin = int(c["value"].min(initial=0))
+        smax = int(c["seq"].max(initial=0))
+        seq_w = _narrow(c["seq"], 0, smax)
+        value_w = _narrow(c["value"], vmin, vmax)
     args = (
         flags,
         np.ascontiguousarray(
             slot, dtype=np.int8 if A <= 127 else np.int16
         ),
         _narrow(c["ctr"], 0, cmax),
-        _narrow(c["seq"], 0, smax),
+        seq_w,
         _narrow(c["obj"], -1, N - 1),
         _narrow(c["key"], -1, max(0, len(batch.keys) - 1)),
         _narrow(c["ref"], -3, N - 1),
-        _narrow(c["value"], vmin, vmax),
+        value_w,
         _narrow(batch.psrc, -1, N - 1),
         _narrow(batch.ptgt, -1, N - 1),
         np.ascontiguousarray(da, np.int32),
@@ -499,17 +507,13 @@ def host_args(batch: ColumnarBatch):
     return args, A, K
 
 
-_LEAN_SKIP = (3, 7)  # seq, value positions in the wire tuple
-
-
 def _device_args(batch: ColumnarBatch, lean: bool = False):
     """(device args, A_loc, K) for the jitted kernels. `lean` skips the
-    seq/value uploads (their slots return None)."""
+    seq/value builds and uploads (their slots are None)."""
     _enable_persistent_compile_cache()
-    np_args, A, K = host_args(batch)
+    np_args, A, K = host_args(batch, lean=lean)
     args = tuple(
-        None if lean and i in _LEAN_SKIP else jnp.asarray(a)
-        for i, a in enumerate(np_args)
+        None if a is None else jnp.asarray(a) for a in np_args
     )
     return args, A, K
 

@@ -373,7 +373,6 @@ class BulkSummaries:
     def __init__(self, pending) -> None:
         # pending: (doc_ids, batch, dec, device_summary_or_None) per slab
         self.slabs: List[Tuple[List[str], ColumnarBatch, Dict]] = []
-        self._decs: List[DecodedBatch] = []
         self._where: Dict[str, Tuple[int, int]] = {}
         for doc_ids, batch, dec, summary in pending:
             arrays = (
@@ -381,8 +380,29 @@ class BulkSummaries:
                 if summary is None  # host-kernel slab: no device refs
                 else fetch_summary(summary, batch.n_rows)
             )
+            if dec.host_clocks is not None:
+                # lean slabs never transferred the seq wire, so the
+                # device clock lane is zeros: rebuild it from the
+                # authoritative host clocks so the columnar contract
+                # (arrays()['clock']) stays consistent with doc()
+                from .crdt_kernels import ensure_doc_actors
+
+                da = ensure_doc_actors(batch)
+                clock = np.array(arrays["clock"])  # device fetches are
+                # read-only buffers; mutate a copy
+                for j, hc in enumerate(dec.host_clocks):
+                    if not hc:
+                        continue
+                    for slot, gid in enumerate(da[j]):
+                        if gid >= 0:
+                            clock[j, slot] = hc.get(
+                                batch.actors[int(gid)], 0
+                            )
+                arrays["clock"] = clock
             self.slabs.append((doc_ids, batch, arrays))
-            self._decs.append(dec)
+            # only small per-doc dicts are retained — the DecodedBatch
+            # (device lanes + column copies) must be releasable once
+            # docs drop their lazy snapshot closures
             for j, d in enumerate(doc_ids):
                 self._where[d] = (len(self.slabs) - 1, j)
 
@@ -398,18 +418,12 @@ class BulkSummaries:
     def doc(self, doc_id: str) -> Dict[str, Any]:
         si, j = self._where[doc_id]
         doc_ids, batch, arrays = self.slabs[si]
-        dec = self._decs[si]
-        clock = (
-            dict(dec.host_clocks[j])
-            if getattr(dec, "host_clocks", None) is not None
-            else _local_clock_dict(
-                batch, _doc_actors_row(batch, j), arrays["clock"][j]
-            )
-        )
         return {
             "elems": int(arrays["n_live_elems"][j]),
             "map_entries": int(arrays["n_map_entries"][j]),
-            "clock": clock,
+            "clock": _local_clock_dict(
+                batch, _doc_actors_row(batch, j), arrays["clock"][j]
+            ),
         }
 
 
