@@ -43,22 +43,43 @@ _TEMPLATE_ACTOR = "actor00"  # synth_changes' single-writer actor name
 INFINITY_SEQ = 2**53 - 1  # crdt/clock.py INFINITY_SEQ
 
 
+class _RecordingStorage(MemoryColumnStorage):
+    """Memory storage that also keeps per-change commit args, so the
+    template can re-serialize them as v2 sidecar records."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.per_change = []
+
+    def commit_change(self, rows, preds, table_lines, flag) -> None:
+        self.per_change.append((rows, preds, list(table_lines), flag))
+        super().commit_change(rows, preds, table_lines, flag)
+
+
 class _Template:
-    """One synthetic history, pre-rendered for per-doc instantiation."""
+    """One synthetic history, pre-rendered for per-doc instantiation.
+
+    The sidecar is split at the first record: its table lines name the
+    writer actor (the only per-doc content), so per doc we re-frame just
+    that record and reuse the remaining bytes verbatim."""
 
     def __init__(self, changes: List[Change]) -> None:
+        from ..storage.colcache import pack_v2_record
+
         self.n_changes = len(changes)
         self.raw_blocks = [bufferify(c.to_json()) for c in changes]
-        cc = FeedColumnCache(MemoryColumnStorage(), writer=_TEMPLATE_ACTOR)
+        store = _RecordingStorage()
+        cc = FeedColumnCache(store, writer=_TEMPLATE_ACTOR)
         for c in changes:
             cc.append_change(c)
-        rows, preds, tables, commits = cc._storage.load()
-        self.rows_bytes = np.ascontiguousarray(rows, np.int32).tobytes()
-        self.preds_bytes = np.ascontiguousarray(preds, np.int32).tobytes()
-        self.commits_bytes = np.ascontiguousarray(
-            commits, np.int32
-        ).tobytes()
-        self.table_lines = tables
+        first_rows, first_preds, first_lines, first_flag = (
+            store.per_change[0]
+        )
+        self.first = (first_rows, first_preds, first_lines, first_flag)
+        self.rest_records = b"".join(
+            pack_v2_record(r, p, t, f)
+            for r, p, t, f in store.per_change[1:]
+        )
 
 
 def _write_doc(
@@ -91,21 +112,20 @@ def _write_doc(
     if sign:
         with open(os.path.join(d, pk + ".sig"), "wb") as fh:
             fh.write(sign_chain(blocks, keymod.decode(pair.secret_key)))
-    # sidecar: identical binary columns; only the writer's actor-table
-    # line names the doc
-    cdir = os.path.join(d, pk + ".cols")
-    os.makedirs(cdir, exist_ok=True)
-    with open(os.path.join(cdir, "rows.bin"), "wb") as fh:
-        fh.write(tpl.rows_bytes)
-    with open(os.path.join(cdir, "preds.bin"), "wb") as fh:
-        fh.write(tpl.preds_bytes)
-    with open(os.path.join(cdir, "commits.bin"), "wb") as fh:
-        fh.write(tpl.commits_bytes)
-    with open(os.path.join(cdir, "tables.jsonl"), "wb") as fh:
-        for line in tpl.table_lines:
-            fh.write(
-                line.replace(_TEMPLATE_ACTOR, pk).encode("utf-8") + b"\n"
-            )
+    # single-file v2 sidecar: re-frame record 0 (its table lines name
+    # the writer actor — the only per-doc content) and reuse the rest
+    from ..storage.colcache import pack_v2_record
+
+    r0_rows, r0_preds, r0_lines, r0_flag = tpl.first
+    first = pack_v2_record(
+        r0_rows,
+        r0_preds,
+        [ln.replace(_TEMPLATE_ACTOR, pk) for ln in r0_lines],
+        r0_flag,
+    )
+    with open(os.path.join(d, pk + ".cols2"), "wb") as fh:
+        fh.write(first)
+        fh.write(tpl.rest_records)
 
 
 def make_corpus(

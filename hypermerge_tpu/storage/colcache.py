@@ -375,13 +375,153 @@ class FileColumnStorage:
             self._fhs = None
 
 
+_V2_HDR = struct.Struct("<IIIB")
+
+
+def pack_v2_record(
+    rows: np.ndarray, preds: np.ndarray, table_lines: List[str], flag: int
+) -> bytes:
+    """One framed v2 sidecar record (shared by the live writer and the
+    corpus writer so both produce byte-identical files)."""
+    tables_bytes = (
+        ("\n".join(table_lines) + "\n").encode("utf-8")
+        if table_lines
+        else b""
+    )
+    return b"".join(
+        (
+            _V2_HDR.pack(len(rows), len(preds), len(tables_bytes), flag),
+            np.ascontiguousarray(rows, np.int32).tobytes(),
+            np.ascontiguousarray(preds, np.int32).tobytes(),
+            tables_bytes,
+        )
+    )
+
+
+class FileColumnStorageV2:
+    """Single-file sidecar: one framed record per committed change.
+
+    Record = <u32 n_rows, u32 n_preds, u32 tables_len, u8 flag>
+             rows_bytes || preds_bytes || tables_bytes(jsonl)
+    A record is valid iff the file holds all the bytes its header names;
+    a torn tail (crash mid-append) simply fails that check and is
+    overwritten by the next append. One open+read per cold load and one
+    append write per change — the 4-file layout (FileColumnStorage,
+    retained read-compatible for old repos) cost a bulk cold start four
+    opens + seven stats PER FEED."""
+
+    _HDR = struct.Struct("<IIIB")
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._end: Optional[int] = None  # valid end offset
+        self._counts = None  # (n_rows, n_preds, n_tables) totals
+
+    def _parse(self, raw: bytes):
+        """(records, valid_end): records are (n_rows, n_preds, tables
+        slice, flag, rows slice, preds slice)."""
+        out = []
+        pos = 0
+        end = len(raw)
+        h = self._HDR
+        while pos + h.size <= end:
+            n_rows, n_preds, t_len, flag = h.unpack_from(raw, pos)
+            body = n_rows * 4 * ROW_FIELDS + n_preds * 4 * PRED_FIELDS + t_len
+            if pos + h.size + body > end:
+                break  # torn tail
+            p = pos + h.size
+            out.append((n_rows, n_preds, t_len, flag, p))
+            pos += h.size + body
+        return out, pos
+
+    def load(self):
+        try:
+            with open(self.path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            raw = b""
+        recs, valid_end = self._parse(raw)
+        self._end = valid_end
+        rows_parts = []
+        pred_parts = []
+        tables: List[str] = []
+        commits = np.zeros((len(recs), COMMIT_FIELDS), np.int32)
+        tr = tp = tt = 0
+        for i, (n_rows, n_preds, t_len, flag, p) in enumerate(recs):
+            rp = p + n_rows * 4 * ROW_FIELDS
+            pp = rp + n_preds * 4 * PRED_FIELDS
+            if n_rows:
+                rows_parts.append(raw[p:rp])
+            if n_preds:
+                pred_parts.append(raw[rp:pp])
+            if t_len:
+                tables.extend(
+                    raw[pp : pp + t_len].decode("utf-8").splitlines()
+                )
+            tr += n_rows
+            tp += n_preds
+            tt = len(tables)
+            commits[i] = (tr, tp, tt, flag)
+        rows = np.frombuffer(b"".join(rows_parts), np.int32).reshape(
+            -1, ROW_FIELDS
+        )
+        preds = np.frombuffer(b"".join(pred_parts), np.int32).reshape(
+            -1, PRED_FIELDS
+        )
+        self._counts = (tr, tp, tt)
+        return rows, preds, tables, commits
+
+    def _ensure_end(self) -> int:
+        if self._end is None:
+            self.load()
+        return self._end
+
+    def commit_change(
+        self,
+        rows: np.ndarray,
+        preds: np.ndarray,
+        table_lines: List[str],
+        flag: int,
+    ) -> None:
+        end = self._ensure_end()
+        rec = pack_v2_record(rows, preds, table_lines, flag)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        mode = "r+b" if os.path.exists(self.path) else "w+b"
+        with open(self.path, mode) as fh:
+            fh.seek(end)  # overwrite any torn tail
+            fh.write(rec)
+            fh.truncate()
+            fh.flush()
+        self._end = end + len(rec)
+
+    def reset(self) -> None:
+        if os.path.exists(self.path):
+            os.remove(self.path)
+        self._end = 0
+        self._counts = None
+
+    def destroy(self) -> None:
+        self.reset()
+        self._end = None
+
+    def close(self) -> None:
+        pass
+
+
 def memory_column_storage_fn(_name: str) -> MemoryColumnStorage:
     return MemoryColumnStorage()
 
 
 def file_column_storage_fn(root: str):
-    def fn(name: str) -> FileColumnStorage:
-        return FileColumnStorage(os.path.join(root, name[:2], name + ".cols"))
+    """New sidecars use the single-file v2 layout; directories written by
+    older versions keep loading through the 4-file reader."""
+
+    def fn(name: str):
+        legacy = os.path.join(root, name[:2], name + ".cols")
+        v2 = os.path.join(root, name[:2], name + ".cols2")
+        if os.path.isdir(legacy) and not os.path.exists(v2):
+            return FileColumnStorage(legacy)
+        return FileColumnStorageV2(v2)
 
     return fn
 

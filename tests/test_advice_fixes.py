@@ -223,23 +223,38 @@ def test_bulk_load_falls_back_on_seq_gap(tmp_path):
     repo.close()
 
     # corrupt the sidecar: bump the last change's seq to fake a gap
+    from hypermerge_tpu.storage.colcache import (
+        FileColumnStorageV2,
+        pack_v2_record,
+    )
+
     feeds_dir = os.path.join(path, "feeds")
     edited = False
-    for root, dirs, _files in os.walk(feeds_dir):
-        for d in dirs:
-            if not d.endswith(".cols"):
+    for root, _dirs, files in os.walk(feeds_dir):
+        for f in files:
+            if not f.endswith(".cols2"):
                 continue
-            rows_path = os.path.join(root, d, "rows.bin")
-            if not os.path.exists(rows_path):
-                continue
-            rows = np.fromfile(rows_path, np.int32).reshape(-1, ROW_FIELDS)
+            st = FileColumnStorageV2(os.path.join(root, f))
+            rows, preds, tables, commits = st.load()
             if not len(rows):
                 continue
             max_seq = rows[:, 2].max()
             if max_seq < 2:
                 continue
+            rows = rows.copy()
             rows[rows[:, 2] == max_seq, 2] = max_seq + 1
-            rows.tofile(rows_path)
+            # re-frame the same per-change records with the edited rows
+            recs = []
+            pr = pp = pt = 0
+            for tr, tp, tt, flag in commits:
+                recs.append(
+                    pack_v2_record(
+                        rows[pr:tr], preds[pp:tp], tables[pt:tt], flag
+                    )
+                )
+                pr, pp, pt = tr, tp, tt
+            with open(os.path.join(root, f), "wb") as fh:
+                fh.write(b"".join(recs))
             edited = True
     assert edited
 
