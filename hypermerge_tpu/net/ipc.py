@@ -1,0 +1,102 @@
+"""Frontend/backend process split — the seam, realized across processes.
+
+Parity: the reference's stated design goal is that RepoFrontend runs on
+a UI thread/process while RepoBackend runs elsewhere, joined only by
+JSON-serializable messages (reference README.md:160-184, one frontend
+per backend). Every message in msgs.py is a plain dict, so the split is
+a transport choice: this module pumps the two queues over a unix-domain
+socket using the same framed duplex the TCP swarm uses.
+
+Backend process:
+    python -m hypermerge_tpu.net.ipc /path/to/repo /tmp/backend.sock
+
+Frontend process:
+    from hypermerge_tpu.net.ipc import connect_frontend
+    front, close = connect_frontend("/tmp/backend.sock")
+    url = front.create({"hello": "world"})
+    ...
+    close()
+
+The XLA bulk path, storage, crypto, and networking all live with the
+backend; the frontend process needs none of them loaded.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Callable, Optional, Tuple
+
+from .tcp import TcpDuplex
+
+
+def serve_backend(
+    sock_path: str,
+    repo_path: Optional[str] = None,
+    memory: bool = False,
+    once: bool = True,
+) -> None:
+    """Host a RepoBackend behind a unix socket. `once` serves a single
+    frontend connection then returns (the reference pairs exactly one
+    frontend per backend)."""
+    from ..backend.repo_backend import RepoBackend
+
+    if os.path.exists(sock_path):
+        os.remove(sock_path)
+    server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    server.bind(sock_path)
+    server.listen(1)
+    print(f"backend ready on {sock_path}", flush=True)
+    while True:
+        conn, _ = server.accept()
+        back = RepoBackend(path=repo_path, memory=memory)
+        duplex = TcpDuplex(conn, is_client=False)
+        back.subscribe(duplex.send)
+        duplex.on_message(back.receive)
+        closed = []
+        duplex.on_close(lambda: closed.append(True))
+        while not closed:
+            import time
+
+            time.sleep(0.1)
+        back.close()
+        if once:
+            server.close()
+            return
+
+
+def connect_frontend(
+    sock_path: str,
+) -> Tuple["RepoFrontend", Callable[[], None]]:
+    """A RepoFrontend wired to a remote backend. Returns (frontend,
+    close)."""
+    from ..frontend.repo_frontend import RepoFrontend
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(sock_path)
+    duplex = TcpDuplex(sock, is_client=True)
+    front = RepoFrontend()
+    front.subscribe(duplex.send)
+    duplex.on_message(front.receive)
+    return front, duplex.close
+
+
+def main() -> None:
+    import sys
+
+    if len(sys.argv) < 3:
+        print(
+            "usage: python -m hypermerge_tpu.net.ipc "
+            "(<repo-path>|:memory:) <socket-path>",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    repo_path, sock_path = sys.argv[1], sys.argv[2]
+    if repo_path == ":memory:":
+        serve_backend(sock_path, memory=True)
+    else:
+        serve_backend(sock_path, repo_path=repo_path)
+
+
+if __name__ == "__main__":
+    main()
