@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import socket
+import threading
 from typing import Callable, Optional, Tuple
 
 from .tcp import TcpDuplex
@@ -49,19 +50,21 @@ def serve_backend(
     print(f"backend ready on {sock_path}", flush=True)
     while True:
         conn, _ = server.accept()
-        back = RepoBackend(path=repo_path, memory=memory)
         duplex = TcpDuplex(conn, is_client=False)
+        if duplex.closed:
+            # failed handshake (probe, misconfigured client): this was
+            # not the frontend — keep the serve slot open
+            continue
+        back = RepoBackend(path=repo_path, memory=memory)
         back.subscribe(duplex.send)
         duplex.on_message(back.receive)
-        closed = []
-        duplex.on_close(lambda: closed.append(True))
-        while not closed:
-            import time
-
-            time.sleep(0.1)
+        gone = threading.Event()
+        duplex.on_close(gone.set)
+        gone.wait()
         back.close()
         if once:
             server.close()
+            os.remove(sock_path)
             return
 
 
@@ -75,6 +78,10 @@ def connect_frontend(
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(sock_path)
     duplex = TcpDuplex(sock, is_client=True)
+    if duplex.closed:
+        raise ConnectionError(
+            f"handshake with backend at {sock_path} failed"
+        )
     front = RepoFrontend()
     front.subscribe(duplex.send)
     duplex.on_message(front.receive)

@@ -27,7 +27,10 @@ def test_frontend_drives_backend_subprocess(tmp_path):
         deadline = time.time() + 60
         while time.time() < deadline and not os.path.exists(sock):
             time.sleep(0.05)
-        assert os.path.exists(sock), proc.stderr.read()
+        if not os.path.exists(sock):
+            proc.kill()  # before stderr.read(): a live process means
+            # read() blocks on an open pipe forever
+            raise AssertionError(proc.stderr.read())
 
         from hypermerge_tpu.net.ipc import connect_frontend
 
@@ -64,3 +67,5 @@ def test_frontend_drives_backend_subprocess(tmp_path):
         if proc.poll() is None:
             proc.kill()
         proc.wait(timeout=10)
+        if os.path.exists(sock):
+            os.remove(sock)
