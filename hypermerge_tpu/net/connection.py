@@ -6,10 +6,11 @@ socket carrying noise-encrypted multiplexed substreams with a
 first buffering until locally opened (the reference's pending-channel
 hack, src/PeerConnection.ts:64-73).
 
-Encryption: the Duplex transport is a seam — the in-memory pair needs
-none; the TCP adapter (net/tcp.py) carries framing and is where a
-noise-style handshake slots in (native C++ codec planned; interface kept
-byte-compatible).
+Encryption lives at the Duplex transport layer: the in-memory test pair
+needs none; the TCP adapter (net/tcp.py) encrypts every frame under an
+X25519 kx handshake + ChaCha20-Poly1305 (net/secure.py, libsodium via
+native/ with a pure fallback) — the reference's noise wrapping
+(src/PeerConnection.ts:36).
 """
 
 from __future__ import annotations

@@ -293,25 +293,36 @@ class FeedIntegrity:
             self._leaves = []
 
     def audit(self, feed) -> bool:
-        """Re-hash the entire block log against the newest stored record.
+        """Re-hash the entire block log against EVERY stored record —
+        the newest covers all blocks; intermediate ones are load-bearing
+        for chunked replication serving, so a corrupted record anywhere
+        in the chain fails the audit (pinned by the tamper fuzz).
         False = blocks or records were tampered with on disk (or the sig
         chain is missing while blocks exist). Reads the feed and
         recomputes independently of the cached state — and takes no
         integrity lock while reading the feed, so a concurrent writer
         (feed lock -> integrity lock) cannot deadlock against it."""
-        rec = self.latest()
-        if rec is None:
+        recs = self.records()
+        if not recs:
             return feed.length == 0
-        length, root, sig = rec
-        if length > feed.length:
+        last_len = recs[-1][0]
+        if last_len > feed.length:
             return False  # records claim more than the log holds
-        blocks = feed.get_batch(0, length)
-        leaves = [crypto.leaf_hash(b) for b in blocks]
-        if crypto.merkle_root(leaves) != root:
-            return False
-        return crypto.verify(
-            signable(length, root), sig, keymod.decode(self.public_key)
-        )
+        wanted = {length for length, _r, _s in recs}
+        blocks = feed.get_batch(0, last_len)
+        peaks = Peaks()
+        roots = {}
+        for b in blocks:
+            peaks.append(crypto.leaf_hash(b))
+            if peaks.length in wanted:
+                roots[peaks.length] = peaks.root()
+        pub = keymod.decode(self.public_key)
+        for length, root, sig in recs:
+            if roots.get(length) != root:
+                return False
+            if not crypto.verify(signable(length, root), sig, pub):
+                return False
+        return True
 
 
 def sign_chain(blocks: List[bytes], seed: bytes) -> bytes:

@@ -429,6 +429,44 @@ def test_fast_open_uses_sidecar_not_replay():
         repo2.close()
 
 
+def test_interactive_churn_during_bulk_load(tmp_path):
+    """Interactive creates/changes racing a bulk cold open must not
+    deadlock (bulk mutex) or lose work (deferred actor syncs)."""
+    import threading
+
+    from hypermerge_tpu.ops.corpus import make_corpus
+
+    urls = make_corpus(str(tmp_path), 24, 64, threads=4)
+    repo = Repo(path=str(tmp_path))
+    made = []
+    errors = []
+
+    def churn():
+        try:
+            for i in range(15):
+                u = repo.create({"i": i})
+                repo.change(u, lambda d, i=i: d.__setitem__("sq", i * i))
+                made.append((u, i))
+        except Exception as e:  # pragma: no cover - failure capture
+            errors.append(e)
+
+    t = threading.Thread(target=churn)
+    t.start()
+    handles = repo.open_many(urls)
+    t.join(timeout=60)
+    assert not t.is_alive(), "churn thread deadlocked against bulk load"
+    assert not errors, errors
+    summ = repo.back.fetch_bulk_summaries()
+    assert len(summ.doc_ids) == 24
+    for u, i in made:
+        got = plainify(repo.doc(u))
+        assert got["i"] == i and got["sq"] == i * i
+    for h in handles[::6]:
+        v = plainify(h.value())
+        assert v and "t" in v  # corpus docs carry their text field
+    repo.close()
+
+
 def test_open_many_lazy_handles():
     """open_many: one bulk backend load, snapshots decoded only when a
     handle is actually read; change() on a lazy handle materializes
