@@ -334,16 +334,18 @@ def test_bulk_load_slabs_split_dispatches():
 
 
 def test_mixed_contiguity_bulk_load_stays_fast(tmp_path):
-    """One gap-y doc in a bulk load must NOT drag the rest onto the
-    per-op host replay path — and the fallback count is surfaced
-    (VERDICT r3 weak #4)."""
+    """One gap-y doc in a 1000-doc bulk load must NOT drag the other 999
+    onto the per-op host replay path — and the fallback count is
+    surfaced (VERDICT r3 weak #4 / next-round item 7)."""
     from hypermerge_tpu.crdt.change import Action, Change, Op, ROOT
+    from hypermerge_tpu.ops.corpus import make_corpus
     from hypermerge_tpu.storage import block as blockmod
 
+    urls = make_corpus(str(tmp_path), 999, 32, ops_per_change=8, threads=4)
     repo = Repo(path=str(tmp_path))
-    urls = [repo.create({"i": i}) for i in range(10)]
-    # poison doc 0's feed with a seq GAP (skips head+1)
-    gap_id = validate_doc_url(urls[0])
+    gap_url = repo.create({"i": -1})
+    # poison the created doc's feed with a seq GAP (skips head+1)
+    gap_id = validate_doc_url(gap_url)
     actor = repo.back.actors[gap_id]
     head = actor.seq_head
     max_op = max(
@@ -360,20 +362,22 @@ def test_mixed_contiguity_bulk_load_stays_fast(tmp_path):
     repo.close()
 
     repo2 = Repo(path=str(tmp_path))
-    ids = [validate_doc_url(u) for u in urls]
+    ids = [validate_doc_url(u) for u in urls] + [gap_id]
     repo2.back.load_documents_bulk(ids)
     stats = repo2.back.last_bulk_stats
-    assert stats["fallback"] == 1 and stats["fast"] == 9, stats
-    # the 9 contiguous docs stayed on the lazy fast path
-    for i, u in enumerate(urls):
-        if i == 0:
-            continue
-        doc = repo2.back.docs[validate_doc_url(u)]
-        assert doc.opset is None, f"doc {i} fell back"
-        assert plainify(repo2.doc(u))["i"] == i
+    assert stats["fallback"] == 1 and stats["fast"] == 999, stats
+    # every contiguous doc stayed on the lazy fast path
+    lazy = sum(
+        1
+        for u in urls
+        if repo2.back.docs[validate_doc_url(u)].opset is None
+    )
+    assert lazy == 999, f"only {lazy}/999 docs stayed lazy"
+    for u in urls[:: 100]:
+        assert "t" in plainify(repo2.doc(u))
     # the gap doc host-replayed its applicable prefix
-    gap_doc = plainify(repo2.doc(urls[0]))
-    assert gap_doc["i"] == 0 and "late" not in gap_doc
+    gap_doc = plainify(repo2.doc(gap_url))
+    assert gap_doc["i"] == -1 and "late" not in gap_doc
     repo2.close()
 
 
