@@ -19,7 +19,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("HM_TEST_TPU") != "1":
+    # CI default: virtual CPU mesh. HM_TEST_TPU=1 leaves the real
+    # (tunneled) TPU platform active — slow first compiles, used for
+    # occasional hardware validation of the device-equivalence tests.
+    jax.config.update("jax_platforms", "cpu")
 
 import random
 

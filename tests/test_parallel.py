@@ -17,6 +17,12 @@ from hypermerge_tpu.parallel.sharded import (
     step,
 )
 
+# mesh tests need the 8-device virtual CPU backend; under HM_TEST_TPU=1
+# (hardware validation runs) only one real chip is visible
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs >= 8 devices (virtual mesh)"
+)
+
 
 def test_mesh_shapes():
     mesh = make_mesh(8, sp=2)
