@@ -74,6 +74,60 @@ def _line_reader(stream):
     return next_line
 
 
+def test_cat_cp_and_serve(tmp_path):
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"kind": "doc"})
+    repo.close()
+
+    # cp a file in, cat it back out
+    src = tmp_path / "payload.bin"
+    src.write_bytes(b"\x01\x02" * 5000)
+    out = _run(["tools/cp.py", path, str(src)])
+    assert out.returncode == 0, out.stderr
+    file_url = out.stdout.strip().splitlines()[-1]
+    assert file_url.startswith("hyperfile:/")
+    out = _run(["tools/cat.py", path, file_url])
+    assert out.returncode == 0, out.stderr
+    assert "10000 bytes" in out.stderr
+    cp_back = str(tmp_path / "back.bin")
+    out = _run(["tools/cp.py", path, file_url, cp_back])
+    assert out.returncode == 0, out.stderr
+    assert open(cp_back, "rb").read() == b"\x01\x02" * 5000
+
+    # cat a doc
+    out = _run(["tools/cat.py", path, url])
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip().splitlines()[-1])["kind"] == "doc"
+
+    # serve + remote watch over TCP
+    serve = subprocess.Popen(
+        [sys.executable, "tools/serve.py", path, "--port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=ENV,
+        cwd=REPO_ROOT,
+    )
+    try:
+        line = ""
+        deadline = time.time() + 60
+        while time.time() < deadline and "serving" not in line:
+            line = serve.stdout.readline()
+        assert "serving" in line, "serve never announced"
+        addr = line.rsplit(" on ", 1)[1].strip()
+        out = _run([
+            "tools/watch.py", str(tmp_path / "peer"), url,
+            "--connect", addr, "--once",
+        ])
+        assert out.returncode == 0, out.stderr
+        state = json.loads(out.stdout.strip().splitlines()[-1])
+        assert state["doc"]["kind"] == "doc"
+    finally:
+        serve.kill()
+        serve.wait(timeout=10)
+
+
 def test_chat_example_end_to_end(tmp_path):
     """serve + join over real TCP; bob's message reaches alice."""
     serve = subprocess.Popen(

@@ -1,0 +1,45 @@
+"""Print a doc's metadata + state, or dump a hyperfile's bytes to
+stdout (reference tools/Cat.ts + tools/Meta.ts).
+
+    python tools/cat.py /path/to/repo 'hypermerge:/<docId>'
+    python tools/cat.py /path/to/repo 'hyperfile:/<fileId>' > out.bin
+"""
+
+import argparse
+import json
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from hypermerge_tpu.models.plain import to_plain  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.utils.ids import is_file_url  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", help="repo directory")
+    ap.add_argument("url", help="doc or hyperfile url")
+    args = ap.parse_args()
+
+    repo = Repo(path=args.repo)
+    if is_file_url(args.url):
+        repo.start_file_server(tempfile.mktemp(suffix=".sock"))
+        header, data = repo.files.read(args.url)
+        print(
+            f"# {header.mime_type}  {header.size} bytes",
+            file=sys.stderr,
+        )
+        sys.stdout.buffer.write(data)
+    else:
+        meta = {}
+        repo.meta(args.url, lambda m: meta.update(m or {}))
+        print("META", json.dumps(meta, default=str), file=sys.stderr)
+        print(json.dumps(to_plain(repo.doc(args.url)), default=str))
+    repo.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Copy a local file into the repo as a hyperfile (prints its url), or
+a hyperfile back out to disk (reference tools/Cp.ts).
+
+    python tools/cp.py /path/to/repo ./photo.png            # -> url
+    python tools/cp.py /path/to/repo 'hyperfile:/<id>' out.png
+"""
+
+import argparse
+import io
+import mimetypes
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.utils.ids import is_file_url  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", help="repo directory")
+    ap.add_argument("src", help="local file, or a hyperfile url")
+    ap.add_argument("dst", nargs="?", help="output path (url src only)")
+    args = ap.parse_args()
+
+    repo = Repo(path=args.repo)
+    repo.start_file_server(tempfile.mktemp(suffix=".sock"))
+    if is_file_url(args.src):
+        header, data = repo.files.read(args.src)
+        out = args.dst or "out.bin"
+        with open(out, "wb") as fh:
+            fh.write(data)
+        print(f"{header.size} bytes ({header.mime_type}) -> {out}")
+    else:
+        mime = (
+            mimetypes.guess_type(args.src)[0]
+            or "application/octet-stream"
+        )
+        with open(args.src, "rb") as fh:
+            header = repo.files.write(io.BytesIO(fh.read()), mime)
+        print(header.url)
+    repo.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Serve a repo to the network: listen on TCP, replicate every feed to
+any peer that proves knowledge of the docs (reference tools/Serve.ts —
+with encrypted transport and capability checks instead of an open
+relay).
+
+    python tools/serve.py /path/to/repo [--port 9130] \
+        [--open 'hypermerge:/<docId>' ...]
+
+Peers connect with TcpSwarm.connect((host, port)) — e.g. the chat
+example's `join`, or tools/watch.py --connect.
+"""
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from hypermerge_tpu.net.tcp import TcpSwarm  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.utils.ids import to_doc_url  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", help="repo directory")
+    ap.add_argument("--port", type=int, default=9130)
+    ap.add_argument(
+        "--open",
+        nargs="*",
+        default=None,
+        help="doc urls to open (default: every doc in the repo)",
+    )
+    args = ap.parse_args()
+
+    repo = Repo(path=args.repo)
+    swarm = TcpSwarm(port=args.port)
+    repo.set_swarm(swarm)
+    urls = args.open or [
+        to_doc_url(d)
+        for d in repo.back.clocks.all_doc_ids(repo.back.id)
+    ]
+    repo.open_many(urls)
+    host, port = swarm.address
+    print(f"serving {len(urls)} docs on {host}:{port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        repo.close()
+        swarm.destroy()
+
+
+if __name__ == "__main__":
+    main()
