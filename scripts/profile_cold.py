@@ -33,10 +33,11 @@ print(f"repo ctor: {time.perf_counter()-t0:.2f}s")
 def run():
     t0 = time.perf_counter()
     handles = repo.open_many(urls)
+    summaries = repo.back.fetch_bulk_summaries()  # the honest barrier
     dt = time.perf_counter() - t0
     print(
-        f"open_many: {dt:.2f}s -> {n_docs*n_ops/dt:,.0f} ops/s "
-        f"({len(handles)} handles)"
+        f"open_many+summaries: {dt:.2f}s -> {n_docs*n_ops/dt:,.0f} ops/s "
+        f"({len(handles)} handles, {len(summaries.doc_ids)} summarized)"
     )
 
 
