@@ -113,18 +113,31 @@ class DocBackend:
         self._check_ready(quiet=quiet)
 
     def _ensure_opset(self) -> None:
-        """Reconstruct the host OpSet from feed history (lazy path)."""
+        """Reconstruct the host OpSet from feed history (lazy path) —
+        only up to the clock this doc has been SERVING: the loader's
+        cursor window may already include newer replicated changes, and
+        folding those into the replay would make the caller's incremental
+        apply a no-op (empty patch -> the frontend never hears about
+        them). The newer changes re-arrive through the caller's window
+        and produce a real patch."""
         with self._lock:
             if self.opset is not None:
                 return
             self.opset = OpSet()
             loader, self._lazy_loader = self._lazy_loader, None
-            self._lazy_clock = None
+            base_clock, self._lazy_clock = self._lazy_clock, None
             self._snapshot_fn = None
             self._snapshot_cache = None
             if loader is not None:
                 with bench("doc:lazyReplay"):
-                    self.opset.apply_changes(loader())
+                    changes = loader()
+                    if base_clock is not None:
+                        changes = [
+                            c
+                            for c in changes
+                            if c.seq <= base_clock.get(c.actor, 0)
+                        ]
+                    self.opset.apply_changes(changes)
 
     def set_actor_id(self, actor_id: str) -> None:
         with self._lock:

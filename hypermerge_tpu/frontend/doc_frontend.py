@@ -40,6 +40,12 @@ class DocFrontend:
         self._lazy_ready = False
         self._ready_requested = False
         self._interested = False  # a reader poked before BulkReady landed
+        # seq of the local change whose backend echo is outstanding.
+        # Committed state only advances via echo patches, so change fns
+        # must run one-per-echo: in-process the echo returns before
+        # change() does (unchanged behavior); cross-process (net/ipc.py)
+        # later fns queue here instead of running against stale state.
+        self._inflight: Optional[int] = None
 
     # ------------------------------------------------------------------
 
@@ -103,6 +109,11 @@ class DocFrontend:
 
     def _run_change(self, fn: Callable, message: str) -> None:
         with self._lock:
+            if self._inflight is not None:
+                # an echo is outstanding: the committed state this fn
+                # would read is stale — run it when the echo lands
+                self._change_queue.append((fn, message))
+                return
             with bench("front:change"):
                 request, preview = self.front.change(
                     fn, self.actor_id, self.seq, message
@@ -110,6 +121,7 @@ class DocFrontend:
             if request is None:
                 return
             self.seq += 1
+            self._inflight = request.seq
         self._fan_out(preview)  # «change preview»
         self._repo.send_request(self.doc_id, request)
 
@@ -126,6 +138,15 @@ class DocFrontend:
         history: int,
     ) -> None:
         with self._lock:
+            if self.mode != "pending":
+                # Ready only initializes a pending doc (reference
+                # DocFrontend.init, src/DocFrontend.ts:121-133). A doc
+                # already reading/writing is AHEAD of this snapshot —
+                # cross-process, the backend's Ready for a just-created
+                # doc arrives after local optimistic changes, and
+                # applying its blank snapshot would clobber them (the
+                # backend's state reaches us through Patch echoes).
+                return
             if patch_json is not None:
                 with bench("front:patch"):
                     self.front.apply_patch(Patch.from_json(patch_json))
@@ -133,12 +154,10 @@ class DocFrontend:
                 self.actor_id = actor_id
                 self.seq = self.front.clock.get(actor_id, 0) + 1
             self.history = history
-            was_pending = self.mode == "pending"
             self.mode = "write" if self.actor_id else "read"
             queued = list(self._change_queue)
             self._change_queue.clear()
-        if was_pending or patch_json is not None:
-            self._fan_out(self.front.materialize())
+        self._fan_out(self.front.materialize())
         for fn, message in queued:
             self._run_change(fn, message)
 
@@ -160,14 +179,25 @@ class DocFrontend:
             self._run_change(fn, message)
 
     def on_patch(self, patch_json: Dict, history: int) -> None:
+        queued = None
         with self._lock:
             patch = Patch.from_json(patch_json)
             with bench("front:patch"):
                 self.front.apply_patch(patch)
             self.history = history
-            if patch.is_empty:
-                return
-        self._fan_out(self.front.materialize())  # «change final» echo
+            if (
+                self._inflight is not None
+                and patch.actor == self.actor_id
+                and patch.seq == self._inflight
+            ):
+                self._inflight = None
+                if self._change_queue:
+                    queued = self._change_queue.pop(0)
+            empty = patch.is_empty
+        if not empty:
+            self._fan_out(self.front.materialize())  # «change final» echo
+        if queued is not None:
+            self._run_change(*queued)
 
     def on_message(self, contents: Any) -> None:
         with self._lock:

@@ -163,6 +163,43 @@ class TestTwoRepos:
         ra.close()
         rb.close()
 
+    def test_remote_patch_reaches_lazily_loaded_doc(self):
+        """A doc served from the lazy (sidecar/device) path must still
+        emit live RemotePatches: the OpSet reconstruction replays only up
+        to the served clock, so the incoming window produces a real
+        patch (was swallowed as an empty patch before — the frontend
+        only looked fresh because re-opens pushed a new Ready)."""
+        ra, rb = self._pair()
+        url = ra.create({"x": 1})
+        states = []
+        h = rb.open(url)
+        h.subscribe(lambda d, i: states.append(dict(d) if d else d))
+        assert states and states[-1]["x"] == 1
+        ra.change(url, lambda d: d.__setitem__("x", 2))
+        # no re-open: the update must arrive via the live patch stream
+        assert states[-1]["x"] == 2, states
+        assert h.value()["x"] == 2
+        h.close()
+
+    def test_stale_ready_does_not_clobber_local_state(self):
+        """A Ready snapshot arriving for a doc already in write mode
+        (cross-process ordering) is ignored — local optimistic state
+        stays ahead (reference DocFrontend.init is pending-only)."""
+        from hypermerge_tpu.repo import Repo as _R
+        from hypermerge_tpu.utils.ids import validate_doc_url
+
+        repo = _R(memory=True)
+        url = repo.create({"a": 1, "log": []})
+        df = repo.front.docs[validate_doc_url(url)]
+        # simulate a late (stale, empty-doc) Ready crossing the seam
+        df.on_ready(df.actor_id, {"clock": {}, "deps": {}, "maxOp": 0,
+                                  "diffs": []}, 0)
+        # local state intact and still writable
+        repo.change(url, lambda d: d["log"].append(7))
+        got = repo.doc(url)
+        assert got["a"] == 1 and list(got["log"]) == [7]
+        repo.close()
+
     def test_watch_remote_updates(self):
         ra, rb = self._pair()
         url = ra.create({"n": 0})
