@@ -163,6 +163,14 @@ class DocFrontend:
 
     def on_actor_id(self, actor_id: str) -> None:
         with self._lock:
+            if self.mode == "write" and actor_id == self.actor_id:
+                # duplicate notification (a NeedsActorId raced the Ready
+                # that already enabled writes): resetting seq from the
+                # clock here would corrupt the counter while a change's
+                # echo is still in flight — the next request would reuse
+                # its seq, be rejected by the backend, and strand the
+                # in-flight queue forever
+                return
             self.actor_id = actor_id
             if self.mode == "pending":
                 # Ready (with the snapshot patch) hasn't landed: flipping

@@ -239,6 +239,67 @@ class TestTwoRepos:
         for r in repos:
             r.close()
 
+    def test_three_repo_tcp_relay_exact_convergence(self):
+        """Concurrent edits on an A<->B<->C TCP line: every edit lands on
+        every repo, exactly once (relay re-serving included). Short CI
+        version of the round-4 soak."""
+        import threading
+        import time as T
+
+        from hypermerge_tpu.net.tcp import TcpSwarm
+
+        repos = [Repo(memory=True) for _ in range(3)]
+        swarms = [TcpSwarm() for _ in range(3)]
+        for r, s in zip(repos, swarms):
+            r.set_swarm(s)
+        swarms[1].connect(swarms[0].address)
+        swarms[2].connect(swarms[1].address)
+        urls = [repos[0].create({"edits": []}) for _ in range(3)]
+        for r in repos[1:]:
+            for u in urls:
+                r.open(u)
+        stop = T.time() + 8
+        counts = [0, 0, 0]
+
+        def churn(idx):
+            import random
+
+            rng = random.Random(idx)
+            while T.time() < stop:
+                repos[idx].change(
+                    rng.choice(urls),
+                    lambda d, i=idx: d["edits"].append(i),
+                )
+                counts[idx] += 1
+                T.sleep(rng.random() * 0.01)
+
+        ts = [
+            threading.Thread(target=churn, args=(i,)) for i in range(3)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        sent = sum(counts)
+        deadline = T.time() + 90
+        while T.time() < deadline:
+            try:
+                totals = [
+                    sum(len(r.doc(u)["edits"]) for u in urls)
+                    for r in repos
+                ]
+            except TimeoutError:
+                T.sleep(0.2)
+                continue
+            if totals == [sent] * 3:
+                break
+            T.sleep(0.2)
+        assert totals == [sent] * 3, (totals, sent)
+        for r in repos:
+            r.close()
+        for s in swarms:
+            s.destroy()
+
 
 class TestTcp:
     """Real-socket transport: two repos converge over localhost TCP."""
