@@ -58,11 +58,15 @@ def _enable_persistent_compile_cache() -> None:
             os.path.expanduser("~"), ".cache", "hypermerge_tpu", "xla"
         ),
     )
-    if not d or jax.default_backend() == "cpu":
+    force = os.environ.get("HM_COMPILE_CACHE_FORCE", "0") == "1"
+    if not d or (jax.default_backend() == "cpu" and not force):
         return
     try:
         jax.config.update("jax_compilation_cache_dir", d)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            0.0 if force else 0.2,
+        )
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:  # unknown flags on an older jax: feature off
         pass

@@ -1,0 +1,75 @@
+"""Measure the true XLA compile cost of the bulk-load kernel bucket.
+
+Pads a small synthetic batch to the production slab bucket shape
+(default [4096, 1024]) and times the first jit call. Run with
+HM_COMPILE_CACHE='' to disable the persistent cache:
+
+    HM_COMPILE_CACHE= python scripts/probe_compile.py [n_docs] [n_rows]
+"""
+
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import numpy as np
+
+
+def padded_batch(n_docs: int, n_rows: int):
+    """A ColumnarBatch of bucket shape [n_docs, n_rows] with one real doc
+    (shapes drive compilation; values don't)."""
+    from hypermerge_tpu.ops.synth import synth_changes
+    from hypermerge_tpu.ops.columnar import PAD, pack_docs
+
+    changes = synth_changes(
+        n_rows // 16, n_actors=1, ops_per_change=16, seed=0
+    )
+    batch = pack_docs([changes], n_rows=n_rows)
+    for k, col in batch.cols.items():
+        pad_val = PAD if k == "action" else 0
+        padded = np.full((n_docs, col.shape[1]), pad_val, dtype=col.dtype)
+        padded[: col.shape[0]] = col
+        batch.cols[k] = padded
+    for name in ("psrc", "ptgt"):
+        col = getattr(batch, name)
+        padded = np.full((n_docs, col.shape[1]), -1, dtype=col.dtype)
+        padded[: col.shape[0]] = col
+        setattr(batch, name, padded)
+    batch.n_ops = np.concatenate(
+        [batch.n_ops, np.zeros(n_docs - batch.n_ops.shape[0], np.int64)]
+    )
+    batch.doc_actors = None
+    batch.slot = None
+    return batch
+
+
+def main():
+    n_docs = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    n_rows = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+
+    from hypermerge_tpu.ops.crdt_kernels import run_batch_full
+
+    t0 = time.perf_counter()
+    batch = padded_batch(n_docs, n_rows)
+    print(f"pack: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    out, summary = run_batch_full(batch, lean=True)
+    np.asarray(summary.clock.ravel()[:1])
+    t1 = time.perf_counter() - t0
+    print(
+        f"first call (compile+run) [{n_docs},{n_rows}]: {t1:.2f}s",
+        file=sys.stderr,
+    )
+    t0 = time.perf_counter()
+    out, summary = run_batch_full(batch, lean=True)
+    np.asarray(summary.clock.ravel()[:1])
+    print(
+        f"second call (run only): {time.perf_counter()-t0:.2f}s",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Decompose the bulk-kernel compile cost: which constructs are slow to
+compile on this backend, and does fori_loop help?
+
+    HM_COMPILE_CACHE= python scripts/probe_compile_parts.py
+"""
+
+import sys
+import time
+from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+D, N = 4096, 1024
+ROUNDS = 11
+
+
+def timed_compile(name, fn, *args):
+    t0 = time.perf_counter()
+    jax.jit(fn).lower(*args).compile()
+    print(f"{name}: {time.perf_counter()-t0:.2f}s", file=sys.stderr)
+
+
+def climb_unrolled(j16):
+    def one(j):
+        for _ in range(ROUNDS):
+            j = j[j.astype(jnp.int32)]
+        return j
+
+    return jax.vmap(one)(j16)
+
+
+def climb_fori(j16):
+    def one(j):
+        return jax.lax.fori_loop(
+            0, ROUNDS, lambda _, x: x[x.astype(jnp.int32)], j
+        )
+
+    return jax.vmap(one)(j16)
+
+
+def wyllie_unrolled(p):
+    def one(p):
+        for _ in range(ROUNDS):
+            q = p[p & 0xFFFF]
+            p = (q & 0xFFFF) | ((p >> 16) + (q >> 16)) << 16
+        return p
+
+    return jax.vmap(one)(p)
+
+
+def wyllie_fori(p):
+    def one(p):
+        def body(_, p):
+            q = p[p & 0xFFFF]
+            return (q & 0xFFFF) | ((p >> 16) + (q >> 16)) << 16
+
+        return jax.lax.fori_loop(0, ROUNDS, body, p)
+
+    return jax.vmap(one)(p)
+
+
+def lexsorts(slot, ctr, gid):
+    def one(s, c, g):
+        o1 = jnp.lexsort((s, c, g))
+        o2 = jnp.lexsort((c, g, s))
+        return o1, o2
+
+    return jax.vmap(one)(slot, ctr, gid)
+
+
+def argsort_only(x):
+    return jax.vmap(jnp.argsort)(x)
+
+
+def scatters(tgt, val):
+    def one(t, v):
+        a = jnp.zeros(N + 1, jnp.int32).at[t].max(v)
+        b = jnp.zeros(N + 1, jnp.int32).at[t].add(v)
+        return a[:N], b[:N]
+
+    return jax.vmap(one)(tgt, val)
+
+
+def main():
+    j16 = jnp.zeros((D, N + 1), jnp.int16)
+    p32 = jnp.zeros((D, N + 1), jnp.int32)
+    slot = jnp.zeros((D, N), jnp.int32)
+    timed_compile("climb_unrolled x11 int16", climb_unrolled, j16)
+    timed_compile("climb_fori x11 int16", climb_fori, j16)
+    timed_compile("wyllie_unrolled x11 int32", wyllie_unrolled, p32)
+    timed_compile("wyllie_fori x11 int32", wyllie_fori, p32)
+    timed_compile("two lexsorts", lexsorts, slot, slot, slot)
+    timed_compile("argsort", argsort_only, slot)
+    timed_compile("scatter max+add", scatters, slot, slot)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Does XLA compile on this backend overlap with host CPU work?
+
+Times: trivial-jit compile, then a big-kernel compile in a background
+thread while the main thread does pure-numpy crunching. If the crunch
+rate is unaffected, compile is remote/GIL-free and a warmup thread can
+hide it behind corpus IO.
+
+    HM_COMPILE_CACHE= python scripts/probe_overlap.py
+"""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+D, N = 4096, 1024
+
+
+def main():
+    t0 = time.perf_counter()
+    jax.jit(lambda x: x + 1).lower(
+        jnp.zeros((D, N), jnp.int32)
+    ).compile()
+    print(
+        f"trivial jit compile: {time.perf_counter()-t0:.2f}s",
+        file=sys.stderr,
+    )
+
+    from scripts.probe_compile import padded_batch
+    from hypermerge_tpu.ops.crdt_kernels import run_batch_full
+
+    batch = padded_batch(D, N)
+
+    # crunch baseline: how much numpy work per second, solo
+    a = np.random.default_rng(0).integers(0, 100, (2048, 2048))
+    def crunch(secs):
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < secs:
+            (a * 3 + 1).sum()
+            n += 1
+        return n / (time.perf_counter() - t0)
+
+    solo = crunch(3.0)
+    print(f"crunch solo: {solo:.1f} iters/s", file=sys.stderr)
+
+    done = {}
+
+    def compile_bg():
+        t0 = time.perf_counter()
+        out, summary = run_batch_full(batch, lean=True)
+        np.asarray(summary.clock.ravel()[:1])
+        done["t"] = time.perf_counter() - t0
+
+    th = threading.Thread(target=compile_bg)
+    t0 = time.perf_counter()
+    th.start()
+    rates = []
+    while th.is_alive():
+        rates.append(crunch(2.0))
+    th.join()
+    print(
+        f"compile in bg thread: {done['t']:.2f}s; crunch during: "
+        f"{np.mean(rates):.1f} iters/s ({np.mean(rates)/solo*100:.0f}% "
+        "of solo)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
