@@ -100,6 +100,16 @@ class RepoBackend:
         self._pending_summaries: List = []
         self.last_bulk_stats: Dict[str, int] = {}
 
+    def identity_seed(self) -> Optional[bytes]:
+        """The repo's static ed25519 seed for transport authentication
+        (net/secure.py auth frames), or None for readonly repos."""
+        from ..utils import base58
+
+        pair = self.key_store.get_or_create("self.repo")
+        if pair.secret_key is None:
+            return None
+        return base58.decode(pair.secret_key)
+
     # ------------------------------------------------------------------
     # wiring
 

@@ -50,6 +50,21 @@ class PeerConnection:
         duplex.on_message(self._on_raw)
         duplex.on_close(self._on_transport_close)
 
+    @property
+    def peer_identity(self):
+        """The peer's transport-proven ed25519 identity (base58), or
+        None on unauthenticated transports (in-memory pairs, legacy
+        anonymous TCP). See net/secure.py auth frames."""
+        return getattr(self._duplex, "peer_identity", None)
+
+    @property
+    def channel_binding(self):
+        """Session-unique exporter over the encrypted transport's
+        ephemeral handshake transcript (None on plaintext transports).
+        Replication MACs it into capability proofs so a proof minted on
+        one connection is worthless on any other."""
+        return getattr(self._duplex, "channel_binding", None)
+
     def open_channel(self, name: str) -> Channel:
         ch = self._channels.get(name)
         if ch is None:

@@ -46,6 +46,14 @@ class Network:
         if self.swarm is not None:
             raise RuntimeError("swarm already set")
         self.swarm = swarm
+        # authenticated transport: hand the repo's static ed25519 seed to
+        # the swarm so every connection's handshake signs the ephemeral
+        # transcript (net/secure.py auth; reference noise-peer static
+        # keys, src/PeerConnection.ts:36). Readonly repos (no secret) and
+        # swarms without identity support stay anonymous.
+        set_id = getattr(swarm, "set_identity", None)
+        if set_id is not None:
+            set_id(self.backend.identity_seed())
         swarm.on_connection(self._on_connection)
         for did in self.backend.feeds.known_discovery_ids():
             self.join(did)
@@ -90,6 +98,19 @@ class Network:
             if peer_id == self.self_id:
                 log("network", "rejecting self-connection")
                 details.reconnect(False)
+                conn.close()
+                return
+            # identity pinning: when the transport authenticated the
+            # peer (net/secure.py auth frames), the repo id it CLAIMS
+            # must be the identity it PROVED — otherwise any
+            # authenticated peer could impersonate another repo
+            proven = conn.peer_identity
+            if proven is not None and peer_id != proven:
+                log(
+                    "network",
+                    f"rejecting peer: claimed id {str(peer_id)[:6]} != "
+                    f"authenticated identity {proven[:6]}",
+                )
                 conn.close()
                 return
             self._add_peer_connection(peer_id, conn)

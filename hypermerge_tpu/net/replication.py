@@ -175,6 +175,16 @@ class ReplicationManager:
         except (KeyError, TypeError, ValueError) as e:
             log("replication", f"malformed msg from {peer.id[:6]}: {e}")
 
+    def _session_binding(self, peer: NetworkPeer) -> tuple:
+        """(channel binding, our transport role) for the peer's CURRENT
+        connection — the two session-unique values capability proofs MAC
+        in (storage/integrity.capability). Plaintext/in-memory
+        transports have no binding; proofs there are challenge+role-only."""
+        conn = peer.connection
+        if conn is None:  # connection torn down with messages in flight
+            return (b"", None)
+        return (conn.channel_binding or b"", conn.is_client)
+
     def _feed_length_msg(
         self, feed: Feed, peer: NetworkPeer, conceal: bool = False
     ) -> Optional[Dict]:
@@ -187,11 +197,14 @@ class ReplicationManager:
             challenge = self._challenge_remote.get(peer)
         if challenge is None:
             return None
+        binding, we_are_client = self._session_binding(peer)
         return {
             "type": "FeedLength",
             "id": feed.discovery_id,
             "length": 0 if conceal else feed.length,
-            "cap": capability(feed.public_key, challenge),
+            "cap": capability(
+                feed.public_key, challenge, binding, we_are_client
+            ),
         }
 
     def _request_msg(
@@ -201,21 +214,41 @@ class ReplicationManager:
             challenge = self._challenge_remote.get(peer)
         if challenge is None:
             return None
+        binding, we_are_client = self._session_binding(peer)
         return {
             "type": "Request",
             "id": feed.discovery_id,
             "from": start,
-            "cap": capability(feed.public_key, challenge),
+            "cap": capability(
+                feed.public_key, challenge, binding, we_are_client
+            ),
         }
 
     def _check_cap(
         self, peer: NetworkPeer, feed: Feed, cap
     ) -> bool:
         """Verify the sender's capability proof against OUR random
-        per-connection challenge; on first success mark the peer
+        per-connection challenge + the transport session binding + the
+        sender's role (see storage/integrity.capability for what each
+        binds against); on first success mark the peer
         replication-eligible for the feed (and reply with our own proof
-        so both directions activate). Returns eligibility."""
-        want = capability(feed.public_key, self._challenge_for(peer))
+        so both directions activate). Returns eligibility.
+
+        Peers already verified for the feed short-circuit: follow-up
+        messages (e.g. live-tail FeedLengths for unsigned feeds, which
+        broadcast without per-peer caps) must not stall or log spurious
+        failures."""
+        if peer in self._verified.get(feed.discovery_id):
+            return True
+        binding, we_are_client = self._session_binding(peer)
+        want = capability(
+            feed.public_key,
+            self._challenge_for(peer),
+            binding,
+            # the PROVER here is the peer (None = torn-down connection:
+            # the compare below fails and the message is moot anyway)
+            None if we_are_client is None else not we_are_client,
+        )
         if not isinstance(cap, str) or not hmac.compare_digest(cap, want):
             log(
                 "replication",
@@ -346,11 +379,18 @@ class ReplicationManager:
         feed = self.feeds.by_discovery_id(did)
         if feed is None:
             return
+        # an unverified peer's Blocks may still be appended (the merkle
+        # signature chain is the real gate), but it earns no re-request
+        # replies: a Request's `from` field is feed.length, metadata
+        # _feed_length_msg deliberately conceals from peers that haven't
+        # proven key knowledge
+        verified = peer in self._verified.get(did)
         if start > feed.length:
             # gap: re-request from our actual head
-            msg = self._request_msg(feed, peer, feed.length)
-            if msg is not None:
-                self._send(peer, msg)
+            if verified:
+                msg = self._request_msg(feed, peer, feed.length)
+                if msg is not None:
+                    self._send(peer, msg)
             return
         raw = [base64.b64decode(b) for b in blocks]
         if sig_b64 is not None and length >= 0:
@@ -379,7 +419,7 @@ class ReplicationManager:
                 "to accept legacy feeds)",
             )
             return
-        if total > feed.length:
+        if total > feed.length and verified:
             # ack-paced stream: pull the next chunk
             msg = self._request_msg(feed, peer, feed.length)
             if msg is not None:
@@ -402,14 +442,18 @@ class ReplicationManager:
             )
             if rec is not None:
                 payload = self._blocks_msg(feed, did, start, end)
+                for peer in self.peers_with_feed(did):
+                    self._send(peer, payload)
             else:
                 # no signature at this exact length: announce and let
-                # peers pull a chunk we CAN sign for
-                payload = {
-                    "type": "FeedLength", "id": did, "length": feed.length,
-                }
-            for peer in self.peers_with_feed(did):
-                self._send(peer, payload)
+                # peers pull a chunk we CAN sign for. Built per peer so
+                # each frame carries that peer's capability proof —
+                # receivers run _check_cap on every FeedLength, and
+                # already-verified peers short-circuit either way
+                for peer in self.peers_with_feed(did):
+                    msg = self._feed_length_msg(feed, peer)
+                    if msg is not None:
+                        self._send(peer, msg)
 
         feed.on_extended(on_extended)
 

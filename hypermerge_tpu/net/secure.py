@@ -1,19 +1,42 @@
-"""SecureSession — authenticated transport encryption for peer sockets.
+"""SecureSession — transport encryption (+ identity auth) for sockets.
 
 Parity: the reference wraps every raw peer socket in a noise-encrypted
 stream before multiplexing (noise-peer, reference
-src/PeerConnection.ts:36). Here the equivalent is libsodium's kx pattern
-(the same construction noise-peer's NN handshake reduces to for
-anonymous peers):
+src/PeerConnection.ts:36). Here the equivalent is libsodium's kx
+pattern, upgraded to mutual authentication when the caller supplies a
+static ed25519 identity (noise-peer's XX mode; the repo's own keypair
+plays the static role):
 
   handshake  each side sends a fresh ephemeral X25519 public key (one
              32-byte frame, the only plaintext on the wire)
   keys       q = X25519(own_sk, peer_pk);
              rx||tx = BLAKE2b-512(q || client_pk || server_pk)
              (client takes rx first — libsodium crypto_kx key schedule)
+  auth       (when an identity is set) the FIRST encrypted frame each
+             direction is identity_pk(32) || ed25519 signature over
+             "hm-auth-v1" || client_pk || server_pk || role. Signing
+             the ephemeral transcript binds the session keys to the
+             identity: an active MITM that substitutes its own
+             ephemerals cannot re-sign the victims' transcripts, so
+             `verify_auth` fails closed and the transport drops.
   frames     ChaCha20-Poly1305-IETF per frame; the 12-byte nonce is a
              per-direction little-endian counter (strictly ordered
              stream over TCP, so counters never repeat or reorder)
+
+Threat model, stated precisely: WITHOUT an identity the handshake is an
+anonymous NN exchange — per-frame integrity holds inside the session,
+but an active MITM can terminate both sides and read/modify traffic.
+WITH identities both peers are mutually authenticated and the claimed
+repo id is pinned to the transport (net/network.py rejects an Info
+whose peerId differs from the proven identity). Auth is negotiated in
+the plaintext flags byte (net/tcp.py), so by default a MITM can strip
+the offer and downgrade both sides to anonymous — deployments that
+must exclude that set HM_NET_AUTH=require, which refuses
+unauthenticated peers outright. Either way
+`channel_binding` exports a value unique to this session's ephemeral
+transcript; the replication capability layer MACs it into every proof
+(storage/integrity.py `capability`), so proofs can never be replayed
+across connections even in anonymous mode.
 
 A tampered ciphertext fails authentication; the transport MUST treat
 that as fatal and drop the connection (net/tcp.py does).
@@ -75,6 +98,13 @@ class SecureSession:
         self._rx_key: Optional[bytes] = None
         self._tx_n = 0
         self._rx_n = 0
+        # session-unique exporter over the ephemeral transcript (set in
+        # complete); MAC'd into replication capability proofs so they
+        # cannot be replayed on another connection
+        self.channel_binding: Optional[bytes] = None
+        # peer's proven ed25519 identity (base58), set by verify_auth
+        self.peer_identity: Optional[str] = None
+        self._transcript: Optional[bytes] = None
 
     @property
     def ready(self) -> bool:
@@ -99,7 +129,42 @@ class SecureSession:
             self._rx_key, self._tx_key = keys[:32], keys[32:]
         else:
             self._tx_key, self._rx_key = keys[:32], keys[32:]
+        self._transcript = client_pk + server_pk
+        self.channel_binding = hashlib.blake2b(
+            b"hm-cb-v1" + self._transcript, digest_size=32
+        ).digest()
         del self._sk
+
+    # -- identity authentication (XX upgrade) --------------------------
+
+    def _signable(self, as_client: bool) -> bytes:
+        role = b"C" if as_client else b"S"
+        return b"hm-auth-v1" + self._transcript + role
+
+    def auth_frame(self, identity_seed: bytes) -> bytes:
+        """identity_pk(32) || sig(64) over this session's transcript +
+        OUR role. Must be sent encrypted, before any user frame."""
+        from ..utils import crypto
+
+        pub = crypto.public_key(identity_seed)
+        sig = crypto.sign(self._signable(self.is_client), identity_seed)
+        return pub + sig
+
+    def verify_auth(self, frame: bytes) -> bool:
+        """Verify the peer's auth frame (their role in the transcript);
+        pins `peer_identity` on success. False = impersonation/MITM —
+        the transport must drop the connection."""
+        from ..utils import base58, crypto
+
+        if len(frame) != 96:
+            return False
+        pub, sig = frame[:32], frame[32:]
+        if not crypto.verify(
+            self._signable(not self.is_client), sig, pub
+        ):
+            return False
+        self.peer_identity = base58.encode(pub)
+        return True
 
     def _nonce(self, n: int) -> bytes:
         return n.to_bytes(12, "little")

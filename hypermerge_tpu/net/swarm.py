@@ -33,6 +33,11 @@ class ConnectionDetails:
 class Swarm:
     """Structural base: join/leave by discovery id; emits connections."""
 
+    def set_identity(self, seed: bytes) -> None:
+        """Static ed25519 seed for transports that authenticate peers
+        (net/tcp.py). Default: ignored — in-process loopback pairs have
+        no wire to protect."""
+
     def join(self, discovery_id: str) -> None:
         raise NotImplementedError
 

@@ -38,7 +38,12 @@ class TcpDuplex:
     the stack). HM_TCP_PLAINTEXT=1 disables encryption (both ends must
     agree)."""
 
-    def __init__(self, sock: socket.socket, is_client: bool = False) -> None:
+    def __init__(
+        self,
+        sock: socket.socket,
+        is_client: bool = False,
+        identity: Optional[bytes] = None,
+    ) -> None:
         from ..utils.queue import Queue
 
         self._sock = sock
@@ -48,6 +53,7 @@ class TcpDuplex:
         self._lock = threading.RLock()
         self.closed = False
         self._session = None
+        self._identity = identity
         if os.environ.get("HM_TCP_PLAINTEXT") != "1":
             from .secure import SecureSession
 
@@ -61,21 +67,76 @@ class TcpDuplex:
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
 
+    @property
+    def channel_binding(self) -> Optional[bytes]:
+        return self._session.channel_binding if self._session else None
+
+    @property
+    def peer_identity(self) -> Optional[str]:
+        return self._session.peer_identity if self._session else None
+
     def _handshake(self) -> None:
-        """Exchange ephemeral public keys (the only plaintext frames)."""
+        """Exchange ephemeral public keys (the only plaintext frames:
+        one flags byte + 32-byte key), then — when BOTH sides offered
+        auth — one encrypted ed25519 auth frame each way over the
+        transcript (net/secure.py). A peer that cannot sign the
+        transcript (MITM key substitution) fails closed.
+
+        Negotiation: the flags byte advertises whether this side will
+        send an auth frame (bit 0). Auth runs only when both offer it;
+        a mixed pair (identity-less peer, HM_NET_AUTH=0, legacy 32-byte
+        handshake) falls back to the anonymous session — unless
+        HM_NET_AUTH=require, which drops unauthenticated peers."""
+        mode = os.environ.get("HM_NET_AUTH", "1")
+        offer = self._identity is not None and mode != "0"
+        if mode == "require" and self._identity is None:
+            raise ValueError("HM_NET_AUTH=require but no identity set")
         self._sock.settimeout(10)
         pk = self._session.handshake_bytes
-        self._sock.sendall(_HDR.pack(len(pk)) + pk)
+        frame = bytes([1 if offer else 0]) + pk
+        self._sock.sendall(_HDR.pack(len(frame)) + frame)
         hdr = self._read_exact(_HDR.size)
         if hdr is None:
             raise OSError("peer closed during handshake")
         (size,) = _HDR.unpack(hdr)
-        if size != 32:
+        if size == 33:
+            flags = self._read_exact(1)
+            if flags is None:
+                raise OSError("peer closed during handshake")
+            peer_offers = bool(flags[0] & 1)
+        elif size == 32:
+            peer_offers = False  # legacy anonymous endpoint
+        else:
             raise ValueError(f"bad handshake frame size {size}")
         peer_pk = self._read_exact(32)
         if peer_pk is None:
             raise OSError("peer closed during handshake")
         self._session.complete(peer_pk)
+        if offer and peer_offers:
+            auth = self._session.encrypt(
+                self._session.auth_frame(self._identity)
+            )
+            self._sock.sendall(_HDR.pack(len(auth)) + auth)
+            hdr = self._read_exact(_HDR.size)
+            if hdr is None:
+                raise OSError("peer closed during auth")
+            (size,) = _HDR.unpack(hdr)
+            if size > 1024:
+                raise ValueError(f"bad auth frame size {size}")
+            wire = self._read_exact(size)
+            if wire is None:
+                raise OSError("peer closed during auth")
+            frame = self._session.decrypt(wire)
+            if frame is None or not self._session.verify_auth(frame):
+                raise ValueError(
+                    "peer identity authentication FAILED "
+                    "(MITM key substitution or signature over a "
+                    "different transcript)"
+                )
+        elif mode == "require":
+            raise ValueError(
+                "peer did not offer identity auth (HM_NET_AUTH=require)"
+            )
         self._sock.settimeout(None)
 
     def on_message(self, cb: Callable[[Any], None]) -> None:
@@ -164,7 +225,12 @@ class TcpDuplex:
 class TcpSwarm(Swarm):
     """Accepts inbound connections; dials peers via `connect(addr)`."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        identity: Optional[bytes] = None,
+    ) -> None:
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((host, port))
@@ -173,10 +239,21 @@ class TcpSwarm(Swarm):
         self._cb: Optional[Callable] = None
         self._duplexes: List[TcpDuplex] = []
         self._destroyed = False
+        self._identity: Optional[bytes] = identity
         self._accepter = threading.Thread(
             target=self._accept_loop, daemon=True
         )
         self._accepter.start()
+
+    def set_identity(self, seed: Optional[bytes]) -> None:
+        """Static ed25519 identity for the authenticated handshake
+        (Network.set_swarm passes the repo keypair's seed). The accept
+        loop runs from construction, so an inbound connection can race
+        this call and handshake anonymously; _handle_inbound re-checks
+        after the handshake and drops such connections (the peer
+        reconnects into the authenticated path). Passing the identity
+        to the constructor avoids the window entirely."""
+        self._identity = seed
 
     def _accept_loop(self) -> None:
         while not self._destroyed:
@@ -191,7 +268,15 @@ class TcpSwarm(Swarm):
             ).start()
 
     def _handle_inbound(self, sock: socket.socket) -> None:
-        duplex = TcpDuplex(sock, is_client=False)
+        ident = self._identity
+        duplex = TcpDuplex(sock, is_client=False, identity=ident)
+        if ident is None and self._identity is not None:
+            # set_identity landed mid-handshake: this connection went
+            # through anonymously and would bypass identity pinning —
+            # drop it; the dialer retries into the authenticated path
+            log("net:tcp", "dropping pre-identity inbound connection")
+            duplex.close()
+            return
         self._duplexes.append(duplex)
         if not duplex.closed and self._cb is not None:
             self._cb(duplex, ConnectionDetails(client=False))
@@ -199,7 +284,7 @@ class TcpSwarm(Swarm):
     def connect(self, address: Tuple[str, int]) -> None:
         sock = socket.create_connection(address, timeout=10)
         sock.settimeout(None)
-        duplex = TcpDuplex(sock, is_client=True)
+        duplex = TcpDuplex(sock, is_client=True, identity=self._identity)
         self._duplexes.append(duplex)
         if not duplex.closed and self._cb is not None:
             self._cb(duplex, ConnectionDetails(client=True))

@@ -349,20 +349,37 @@ def allow_unsigned() -> bool:
     return os.environ.get("HM_ALLOW_UNSIGNED_FEEDS") == "1"
 
 
-def capability(public_key: str, challenge: bytes) -> str:
+def capability(
+    public_key: str,
+    challenge: bytes,
+    binding: bytes = b"",
+    prover_is_client: Optional[bool] = None,
+) -> str:
     """Proof of feed-key knowledge for the replication protocol
     (hypercore-protocol's capability verification, reference
     src/types/hypercore-protocol.d.ts:62-106): a keyed hash only a
     holder of the feed PUBLIC key can compute — discovery ids alone
     (which peers learn from announcements) must not unlock block data.
-    Bound to the VERIFIER's per-connection random challenge, so a proof
-    captured on one connection (or handed to an impersonator) is
-    worthless on any other."""
+
+    The MAC input binds three things (hypercore-protocol binds its
+    capabilities to the noise session the same way):
+    - the VERIFIER's per-connection random `challenge`;
+    - the transport session's channel `binding` (net/secure.py
+      exporter over the ephemeral handshake transcript), so a proof
+      obtained on one connection cannot be replayed on another even by
+      a peer that controls the challenge it hands out;
+    - the PROVER's transport role (client/server), so a proof we send
+      on a connection cannot be mirrored straight back to us on that
+      same connection by a peer that chose its challenge equal to ours.
+    """
     import hashlib
 
+    role = b""
+    if prover_is_client is not None:
+        role = b"C" if prover_is_client else b"S"
     return keymod.encode(
         hashlib.blake2b(
-            b"hm-cap:" + challenge,
+            b"hm-cap:" + challenge + b"|" + binding + b"|" + role,
             key=keymod.decode(public_key),
             digest_size=32,
         ).digest()

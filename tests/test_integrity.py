@@ -5,6 +5,7 @@ Reference anchor: hypercore's signed tree + per-block verification
 
 import base64
 import os
+import time
 
 import pytest
 
@@ -243,7 +244,8 @@ class TestReplicationVerification:
         challenge = mgr_a._challenge_local[pa]
         ch.send({
             "type": "Request", "id": did, "from": 0,
-            "cap": capability(pair.public_key, challenge),
+            # B proves from the server side of the a<->b duplex pair
+            "cap": capability(pair.public_key, challenge, b"", False),
         })
         assert any(
             m.get("type") == "Blocks" for m in got if isinstance(m, dict)
@@ -268,7 +270,7 @@ class TestReplicationVerification:
         # the cap B proved with on the a<->b connection (bound to the
         # challenge A issued there)
         stale_cap = capability(
-            pair.public_key, mgr_a._challenge_local[pa]
+            pair.public_key, mgr_a._challenge_local[pa], b"", False
         )
         # attacker C (knows only the discovery id) replays it on a<->c
         _pca, pcc = _connect(mgr_a, mgr_c)
@@ -282,6 +284,60 @@ class TestReplicationVerification:
             "type": "Request", "id": fa.discovery_id, "from": 0,
             "cap": stale_cap,
         })
+        assert not any(
+            m.get("type") == "Blocks" for m in got if isinstance(m, dict)
+        ), got
+
+    def test_capability_not_mintable_by_challenge_reflection(self):
+        """ADVICE r4 high: an attacker knowing only the discovery id
+        sets ITS challenge equal to the one we issued it, then replays
+        the proactive proof from our concealed FeedLength as its own.
+        The proof MACs the PROVER's transport role, so the mirrored
+        value never verifies and blocks stay withheld."""
+        feeds_a, mgr_a, _ = _mgr()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        fa.append(b"secret-block")
+
+        # raw attacker endpoint: a bare PeerConnection, no manager
+        da, db = duplex_pair()
+        ca, cb = PeerConnection(da, True), PeerConnection(db, False)
+        pa = NetworkPeer("X", "A", lambda p: None)
+        pa.add_connection(ca)
+        mgr_a.on_peer(pa)
+
+        got = []
+        cb.open_channel("Replication").subscribe(got.append)
+        # A's opener carries the challenge A wants proofs against
+        for _ in range(100):
+            if got:
+                break
+            time.sleep(0.01)
+        opener = got[0]
+        assert opener["type"] == "DiscoveryIds"
+        a_challenge = opener["challenge"]
+
+        # reflect: announce the did with challenge := A's own challenge
+        cb.open_channel("Replication").send({
+            "type": "DiscoveryIds",
+            "ids": [fa.discovery_id],
+            "challenge": a_challenge,
+        })
+        # A proactively sends its concealed FeedLength whose cap is
+        # capability(pk, a_challenge, binding, A's role)
+        for _ in range(100):
+            if any(m.get("type") == "FeedLength" for m in got[1:]):
+                break
+            time.sleep(0.01)
+        fl = next(m for m in got[1:] if m.get("type") == "FeedLength")
+        assert fl["length"] == 0  # concealed from the unproven peer
+
+        # mirror the cap straight back as our "proof"
+        cb.open_channel("Replication").send({
+            "type": "Request", "id": fa.discovery_id, "from": 0,
+            "cap": fl["cap"],
+        })
+        time.sleep(0.2)
         assert not any(
             m.get("type") == "Blocks" for m in got if isinstance(m, dict)
         ), got
