@@ -206,6 +206,15 @@ class DocFrontend:
             self._fan_out(self.front.materialize())  # «change final» echo
         if queued is not None:
             self._run_change(*queued)
+            # a no-op change fn produces no request and leaves _inflight
+            # unset — keep draining, or the remaining queued changes
+            # would strand until an unrelated patch happened to arrive
+            while True:
+                with self._lock:
+                    if self._inflight is not None or not self._change_queue:
+                        break
+                    nxt = self._change_queue.pop(0)
+                self._run_change(*nxt)
 
     def on_message(self, contents: Any) -> None:
         with self._lock:

@@ -346,15 +346,15 @@ def _prefix_single_ok(fc) -> bool:
     order). Cached on the FeedColumns object."""
     ok = getattr(fc, "_prefix_single_ok", None)
     if ok is None:
-        r = fc.rows
-        n = len(r)
+        n = fc.n_rows
+        ctr = fc.plane("ctr")
         ok = bool(
-            np.all(r[:, 5] <= 0)  # obj actor: ROOT or the writer
-            and np.all(r[:, 8] <= 0)  # ref actor: writer or sentinel
+            np.all(fc.plane("obj_a") <= 0)  # obj actor: ROOT or writer
+            and np.all(fc.plane("ref_a") <= 0)  # writer or sentinel
             # dense lamport counters: row i is op ctr i+1, so references
             # resolve as ctr-1 with no search
             and np.array_equal(
-                r[:, 1], np.arange(1, n + 1, dtype=np.int32)
+                ctr, np.arange(1, n + 1, dtype=ctr.dtype)
             )
             and (len(fc.preds) == 0 or np.all(fc.preds[:, 2] == 0))
         )
@@ -439,9 +439,6 @@ def _try_pack_prefix_single(
         )
 
     fc_idx_a = np.asarray(fc_idx, np.int64)
-    R = np.concatenate(
-        [fcs[fc_idx[d]].rows[: ends[d]] for d in range(D)], axis=0
-    )
     doc_col = np.repeat(np.arange(D, dtype=np.int64), ends)
     doc_starts = np.zeros(D + 1, np.int64)
     np.cumsum(ends, out=doc_starts[1:])
@@ -449,43 +446,28 @@ def _try_pack_prefix_single(
         np.int32
     )
 
-    # dense ctr (qualification): doc-local row of op ctr c is c-1
     from ..storage.colcache import OBJ_ROOT, REF_HEAD, REF_NONE
 
-    need_obj = R[:, 5] == 0
-    need_ref = R[:, 8] == 0
-    obj_row = np.where(need_obj, R[:, 4] - 1, OBJ_ROOT)
-    ref_row = np.where(
-        need_ref, R[:, 7] - 1, np.where(R[:, 8] == -2, REF_HEAD, REF_NONE)
-    )
-
-    # -- key/value global remap -----------------------------------------
-    def flat_lut(kind):
-        offs = np.zeros(len(fcs) + 1, np.int64)
-        for i, l in enumerate(luts[kind]):
-            offs[i + 1] = offs[i] + len(l)
-        flat = (
-            np.concatenate(luts[kind])
-            if any(len(l) for l in luts[kind])
-            else np.zeros(1, np.int64)
+    # column sources: v3 plane-backed feeds serve each column as a
+    # contiguous narrow array (concat promotes mixed widths); v2 feeds
+    # fall back to strided slices of the dense row matrix. The narrow
+    # path moves a fraction of the bytes — on a 10M-row bulk pack the
+    # difference is seconds of single-core memcpy.
+    use_planes = all(fc.planes is not None for fc in fcs)
+    if use_planes:
+        def col(name):
+            return np.concatenate(
+                [fcs[fc_idx[d]].plane(name)[: ends[d]] for d in range(D)]
+            )
+    else:
+        R = np.concatenate(
+            [fcs[fc_idx[d]].ensure_rows()[: ends[d]] for d in range(D)],
+            axis=0,
         )
-        return flat, offs
+        from ..storage.colcache import PLANE_NAMES
 
-    klut, koffs = flat_lut("k")
-    off_doc = np.repeat(koffs[fc_idx_a], ends)
-    key_l = R[:, 6].astype(np.int64)
-    safe = np.minimum(np.maximum(off_doc + key_l, 0), len(klut) - 1)
-    key_g = np.where(key_l >= 0, klut[safe], -1)
-    vkind = R[:, 10]
-    value_g = R[:, 11].astype(np.int64)
-    from ..storage.colcache import VK_BIGINT, VK_FLOAT, VK_STR
-
-    for code, kind in ((VK_STR, "s"), (VK_FLOAT, "f"), (VK_BIGINT, "b")):
-        m = vkind == code
-        if m.any():
-            lut, offs = flat_lut(kind)
-            oc = np.repeat(offs[fc_idx_a], ends)
-            value_g[m] = lut[oc[m] + value_g[m]]
+        def col(name):
+            return R[:, PLANE_NAMES.index(name)]
 
     # -- preds ----------------------------------------------------------
     pr_doc_l: List[np.ndarray] = []
@@ -511,7 +493,7 @@ def _try_pack_prefix_single(
         pred_counts = np.zeros(Dp, np.int64)
         p_src_row = p_tgt_row = p_pos = pr_doc = np.zeros(0, np.int64)
 
-    # -- scatter into padded [Dp, N] ------------------------------------
+    # -- bucket shapes ---------------------------------------------------
     max_ops = int(ends.max(initial=0))
     max_preds = int(pred_counts.max(initial=0))
     N = n_rows if n_rows is not None else _round_up(max(max_ops, 1))
@@ -521,18 +503,69 @@ def _try_pack_prefix_single(
             f"doc exceeds bucket: ops {max_ops}>{N} or preds {max_preds}>{P}"
         )
     flat_idx = doc_col * N + pos
+
+    # -- derived columns, computed in (near-)wire dtypes ----------------
+    i16ok = N < 2**15
+    row_dt = np.int16 if i16ok else np.int32
+
+    obj_a = col("obj_a")
+    obj_row = np.where(
+        obj_a == 0, col("obj_ctr").astype(row_dt) - 1, row_dt(OBJ_ROOT)
+    )
+    del obj_a
+    ref_a = col("ref_a")
+    ref_row = np.where(
+        ref_a == 0,
+        col("ref_ctr").astype(row_dt) - 1,
+        np.where(
+            ref_a == -2, row_dt(REF_HEAD), row_dt(REF_NONE)
+        ).astype(row_dt),
+    )
+    del ref_a
+
+    # -- key/value global remap -----------------------------------------
+    def flat_lut(kind):
+        offs = np.zeros(len(fcs) + 1, np.int64)
+        for i, l in enumerate(luts[kind]):
+            offs[i + 1] = offs[i] + len(l)
+        flat = (
+            np.concatenate(luts[kind])
+            if any(len(l) for l in luts[kind])
+            else np.zeros(1, np.int64)
+        )
+        return flat, offs
+
+    klut, koffs = flat_lut("k")
+    kdt = np.int16 if len(key_int.items) < 2**15 else np.int32
+    key_l = col("key").astype(np.int64)
+    off_doc = np.repeat(koffs[fc_idx_a], ends)
+    safe = np.minimum(np.maximum(off_doc + key_l, 0), len(klut) - 1)
+    key_g = np.where(key_l >= 0, klut[safe].astype(kdt), kdt(-1))
+    del safe, off_doc, key_l
+    vkind = col("vkind")
+    value_g = col("value").astype(np.int64)
+    from ..storage.colcache import VK_BIGINT, VK_FLOAT, VK_STR
+
+    for code, kind in ((VK_STR, "s"), (VK_FLOAT, "f"), (VK_BIGINT, "b")):
+        m = vkind == code
+        if m.any():
+            lut, offs = flat_lut(kind)
+            oc = np.repeat(offs[fc_idx_a], ends)
+            value_g[m] = lut[oc[m] + value_g[m]]
+
+    # -- scatter into padded [Dp, N] ------------------------------------
     cols: Dict[str, np.ndarray] = {}
     defaults = {"action": PAD, "obj": -1, "key": -1, "ref": -3}
     sources = {
-        "action": R[:, 0], "actor": np.repeat(writer_g[fc_idx_a], ends),
-        "ctr": R[:, 1], "seq": R[:, 2], "obj": obj_row, "key": key_g,
-        "ref": ref_row, "insert": R[:, 9], "vkind": vkind,
-        "value": value_g, "dt": R[:, 12],
+        "action": col("action"),
+        "actor": np.repeat(writer_g[fc_idx_a], ends),
+        "ctr": col("ctr"), "seq": col("seq"), "obj": obj_row,
+        "key": key_g, "ref": ref_row, "insert": col("insert"),
+        "vkind": vkind, "value": value_g, "dt": col("dt"),
     }
     # allocate the device wire dtypes directly (host_args then passes
     # them through copy-free): everything row-indexed fits int16 when
     # N < 32k — the common case — and flags planes fit uint8
-    i16ok = N < 2**15
     vmin = int(value_g.min(initial=0))
     vmax = int(value_g.max(initial=0))
     dtypes = {
@@ -541,11 +574,11 @@ def _try_pack_prefix_single(
         "vkind": np.uint8,
         "dt": np.uint8,
         "actor": np.int32,  # batch-global ids (host/decode only)
-        "ctr": np.int16 if i16ok else np.int32,
-        "seq": np.int16 if i16ok else np.int32,
-        "obj": np.int16 if i16ok else np.int32,
-        "key": np.int16 if len(key_int.items) < 2**15 else np.int32,
-        "ref": np.int16 if i16ok else np.int32,
+        "ctr": row_dt,
+        "seq": row_dt,
+        "obj": row_dt,
+        "key": kdt,
+        "ref": row_dt,
         "value": (
             np.int16
             if i16ok and -(2**15) <= vmin and vmax < 2**15
@@ -702,7 +735,7 @@ def pack_docs_columns(
             lo, hi = fc.window(int(s), e)
             if hi <= lo:
                 continue
-            row_slices.append(fc.rows[lo:hi])
+            row_slices.append(fc.ensure_rows()[lo:hi])
             w_doc.append(d)
             w_fc.append(fci)
             w_cnt.append(hi - lo)

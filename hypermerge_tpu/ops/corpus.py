@@ -43,43 +43,48 @@ _TEMPLATE_ACTOR = "actor00"  # synth_changes' single-writer actor name
 INFINITY_SEQ = 2**53 - 1  # crdt/clock.py INFINITY_SEQ
 
 
-class _RecordingStorage(MemoryColumnStorage):
-    """Memory storage that also keeps per-change commit args, so the
-    template can re-serialize them as v2 sidecar records."""
-
-    def __init__(self) -> None:
-        super().__init__()
-        self.per_change = []
-
-    def commit_change(self, rows, preds, table_lines, flag) -> None:
-        self.per_change.append((rows, preds, list(table_lines), flag))
-        super().commit_change(rows, preds, table_lines, flag)
-
-
 class _Template:
     """One synthetic history, pre-rendered for per-doc instantiation.
 
-    The sidecar is split at the first record: its table lines name the
-    writer actor (the only per-doc content), so per doc we re-frame just
-    that record and reuse the remaining bytes verbatim."""
+    The sidecar is one v3 checkpoint (storage/colcache.py): the planes,
+    preds, and row-ends bytes are doc-invariant and rendered ONCE as
+    `_body`; only the interner-tables blob names the writer actor, so
+    per doc the checkpoint re-frames that blob around the shared body."""
 
     def __init__(self, changes: List[Change]) -> None:
-        from ..storage.colcache import pack_v2_record
+        from ..storage.colcache import (
+            planes_from_rows,
+            v3_body_bytes,
+            v3_frame,
+        )
 
         self.n_changes = len(changes)
         self.raw_blocks = [bufferify(c.to_json()) for c in changes]
-        store = _RecordingStorage()
-        cc = FeedColumnCache(store, writer=_TEMPLATE_ACTOR)
+        cc = FeedColumnCache(
+            MemoryColumnStorage(), writer=_TEMPLATE_ACTOR
+        )
         for c in changes:
             cc.append_change(c)
-        first_rows, first_preds, first_lines, first_flag = (
-            store.per_change[0]
+        fc = cc.columns()
+        planes = (
+            fc.planes
+            if fc.planes is not None
+            else planes_from_rows(fc.ensure_rows())
         )
-        self.first = (first_rows, first_preds, first_lines, first_flag)
-        self.rest_records = b"".join(
-            pack_v2_record(r, p, t, f)
-            for r, p, t, f in store.per_change[1:]
+        row_ends = np.asarray(cc._commits_arr[:, 0], np.int64)
+        flags = np.asarray(cc._commits_arr[:, 3], np.uint8)
+        self._body = v3_body_bytes(planes, fc.preds, row_ends, flags)
+        self._shape = (fc.n_rows, len(row_ends), len(fc.preds))
+        self._tables = cc._tables_blob()
+        self._frame = v3_frame
+
+    def checkpoint_bytes(self, writer_pk: str) -> bytes:
+        """The doc's sidecar: the shared checkpoint body framed with the
+        writer actor substituted in the tables blob."""
+        tables = self._tables.replace(
+            _TEMPLATE_ACTOR.encode("ascii"), writer_pk.encode("ascii")
         )
+        return self._frame(self._body, *self._shape, tables)
 
 
 def _write_doc(
@@ -112,20 +117,10 @@ def _write_doc(
     if sign:
         with open(os.path.join(d, pk + ".sig"), "wb") as fh:
             fh.write(sign_chain(blocks, keymod.decode(pair.secret_key)))
-    # single-file v2 sidecar: re-frame record 0 (its table lines name
-    # the writer actor — the only per-doc content) and reuse the rest
-    from ..storage.colcache import pack_v2_record
-
-    r0_rows, r0_preds, r0_lines, r0_flag = tpl.first
-    first = pack_v2_record(
-        r0_rows,
-        r0_preds,
-        [ln.replace(_TEMPLATE_ACTOR, pk) for ln in r0_lines],
-        r0_flag,
-    )
+    # single-file sidecar: one v3 checkpoint with this doc's writer
+    # substituted in the tables blob (everything else is doc-invariant)
     with open(os.path.join(d, pk + ".cols2"), "wb") as fh:
-        fh.write(first)
-        fh.write(tpl.rest_records)
+        fh.write(tpl.checkpoint_bytes(pk))
 
 
 def make_corpus(

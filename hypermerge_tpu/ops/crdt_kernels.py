@@ -511,14 +511,26 @@ def host_args(batch: ColumnarBatch, lean: bool = False):
     return args, A, K
 
 
+# stage timings of the most recent _device_args call — the bulk loader
+# folds these into last_bulk_stats for the bench's stage breakdown
+last_args_timings: Dict[str, float] = {}
+
+
 def _device_args(batch: ColumnarBatch, lean: bool = False):
     """(device args, A_loc, K) for the jitted kernels. `lean` skips the
     seq/value builds and uploads (their slots are None)."""
+    import time
+
     _enable_persistent_compile_cache()
+    t0 = time.perf_counter()
     np_args, A, K = host_args(batch, lean=lean)
+    t1 = time.perf_counter()
     args = tuple(
         None if a is None else jnp.asarray(a) for a in np_args
     )
+    t2 = time.perf_counter()
+    last_args_timings["narrow"] = t1 - t0
+    last_args_timings["upload"] = t2 - t1
     return args, A, K
 
 

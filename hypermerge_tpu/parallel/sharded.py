@@ -43,8 +43,11 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
     Returns (args, A_loc, K, D_pad) — the same narrow wire args (and the
     same A_loc/K bucketing) as the single-device path, so both compile to
     the same per-shard program; only the sharding differs."""
+    import time
+
     import numpy as np
 
+    from ..ops import crdt_kernels as _ck
     from ..ops.crdt_kernels import (
         _enable_persistent_compile_cache,
         host_args,
@@ -55,7 +58,9 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
     D = batch.n_docs
     D_pad = pad_to_multiple(max(D, dp), dp)
     sh = doc_sharding(mesh)
+    t0 = time.perf_counter()
     np_args, A, K = host_args(batch)
+    t1 = time.perf_counter()
 
     def put(arr, pad_value):
         if D_pad != arr.shape[0]:
@@ -66,6 +71,8 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
         return jax.device_put(arr, sh)
 
     args = tuple(put(a, pv) for a, pv in zip(np_args, _PAD_VALUES))
+    _ck.last_args_timings["narrow"] = t1 - t0
+    _ck.last_args_timings["upload"] = time.perf_counter() - t1
     return args, A, K, D_pad
 
 

@@ -79,18 +79,32 @@ REF_HEAD = -2
 REF_NONE = -3
 
 
+# plane order == row column order (module docstring row layout)
+PLANE_NAMES = (
+    "action", "ctr", "seq", "start_op", "obj_ctr", "obj_a", "key",
+    "ref_ctr", "ref_a", "insert", "vkind", "value", "dt", "flags",
+)
+
+
 @dataclass
 class FeedColumns:
     """One feed's ops as numpy columns + feed-local tables.
 
-    `rows` is [n_ops, ROW_FIELDS] int32; `preds` is [n_preds, 3] int32.
-    `seq` (= rows[:, 2]) is nondecreasing, so change windows slice via
-    np.searchsorted. `ok_prefix_len` is the number of leading non-corrupt
-    changes — the host OpSet can never apply past the first corrupt block
-    of an actor, so bulk windows clamp to it.
+    Two storage shapes, one interface: `rows` is [n_ops, ROW_FIELDS]
+    int32 (v2 record streams materialize it directly); a v3 checkpoint
+    instead carries `planes` — one contiguous array per column in the
+    minimal dtype that holds it — and leaves `rows` None until a
+    consumer calls `ensure_rows()`. The bulk pack fast path
+    (ops/columnar.py) reads planes without ever widening to the row
+    matrix; everything else upgrades transparently.
+
+    `preds` is [n_preds, 3] int32. `seq` is nondecreasing, so change
+    windows slice via np.searchsorted. `ok_prefix_len` is the number of
+    leading non-corrupt changes — the host OpSet can never apply past
+    the first corrupt block of an actor, so bulk windows clamp to it.
     """
 
-    rows: np.ndarray
+    rows: Optional[np.ndarray]
     preds: np.ndarray
     actors: List[str]
     keys: List[str]
@@ -102,10 +116,32 @@ class FeedColumns:
     # per-change cumulative row counts, len n_changes+1: change i (seq
     # i+1) owns rows [row_ends[i], row_ends[i+1])
     row_ends: np.ndarray
+    planes: Optional[Dict[str, np.ndarray]] = None
+
+    @property
+    def n_rows(self) -> int:
+        if self.rows is not None:
+            return len(self.rows)
+        return len(self.planes["action"]) if self.planes else 0
+
+    def plane(self, name: str) -> np.ndarray:
+        """One column, narrow dtype when plane-backed."""
+        if self.planes is not None:
+            return self.planes[name]
+        return self.rows[:, PLANE_NAMES.index(name)]
+
+    def ensure_rows(self) -> np.ndarray:
+        """Materialize (and cache) the [n, ROW_FIELDS] int32 matrix —
+        the general pack path and per-op consumers want row slices."""
+        if self.rows is None:
+            self.rows = rows_from_planes(self.planes)
+        return self.rows
 
     @property
     def seq(self) -> np.ndarray:
-        return self.rows[:, 2]
+        if self.rows is not None:
+            return self.rows[:, 2]
+        return self.plane("seq")
 
     def window(self, start_seq: int, end_seq: float) -> Tuple[int, int]:
         """Row range [lo, hi) for changes with seq in (start_seq, end_seq],
@@ -130,7 +166,7 @@ class FeedColumns:
         corrupt-then-healed out-of-band — must fail loudly, not produce a
         silently wrong clock."""
         n = int(self.row_ends[-1]) if len(self.row_ends) else 0
-        if n != len(self.rows):
+        if n != self.n_rows:
             return False
         expected = np.repeat(
             np.arange(1, self.n_changes + 1, dtype=np.int64),
@@ -378,6 +414,144 @@ class FileColumnStorage:
 _V2_HDR = struct.Struct("<IIIB")
 
 
+_V3_MAGIC = b"HMc3"
+_V3_HDR = struct.Struct("<IIII")  # n_rows, n_changes, n_preds, tables_len
+_V3_DTYPES = (np.int8, np.int16, np.int32, np.uint8)
+
+
+def _narrow_plane(col: np.ndarray) -> np.ndarray:
+    """Minimal-dtype copy of one int32 column."""
+    if len(col) == 0:
+        return col.astype(np.int8)
+    lo, hi = int(col.min()), int(col.max())
+    if 0 <= lo and hi <= 255:
+        return col.astype(np.uint8)
+    if -128 <= lo and hi <= 127:
+        return col.astype(np.int8)
+    if -(2**15) <= lo and hi <= 2**15 - 1:
+        return col.astype(np.int16)
+    return np.ascontiguousarray(col, np.int32)
+
+
+def planes_from_rows(rows: np.ndarray) -> Dict[str, np.ndarray]:
+    return {
+        name: _narrow_plane(rows[:, i])
+        for i, name in enumerate(PLANE_NAMES)
+    }
+
+
+def rows_from_planes(planes: Dict[str, np.ndarray]) -> np.ndarray:
+    n = len(planes["action"])
+    rows = np.empty((n, ROW_FIELDS), np.int32)
+    for i, name in enumerate(PLANE_NAMES):
+        rows[:, i] = planes[name]
+    return rows
+
+
+def v3_body_bytes(
+    planes: Dict[str, np.ndarray],
+    preds: np.ndarray,
+    row_ends: np.ndarray,
+    flags: np.ndarray,
+) -> bytes:
+    """Everything between the v3 header and the tables blob — the
+    doc-invariant middle the corpus writer renders once per template."""
+    n_changes = len(row_ends)
+    n_rows = int(row_ends[-1]) if n_changes else 0
+    parts = []
+    for name in PLANE_NAMES:
+        p = planes[name]
+        assert len(p) == n_rows, (name, len(p), n_rows)
+        parts.append(bytes([_V3_DTYPES.index(p.dtype.type)]))
+        parts.append(np.ascontiguousarray(p).tobytes())
+    parts.append(np.ascontiguousarray(row_ends, np.int64).tobytes())
+    parts.append(np.ascontiguousarray(flags, np.uint8).tobytes())
+    parts.append(np.ascontiguousarray(preds, np.int32).tobytes())
+    return b"".join(parts)
+
+
+def v3_frame(
+    body: bytes,
+    n_rows: int,
+    n_changes: int,
+    n_preds: int,
+    tables_bytes: bytes,
+) -> bytes:
+    return b"".join(
+        (
+            _V3_MAGIC,
+            _V3_HDR.pack(n_rows, n_changes, n_preds, len(tables_bytes)),
+            body,
+            tables_bytes,
+        )
+    )
+
+
+def pack_v3_checkpoint(
+    planes: Dict[str, np.ndarray],
+    preds: np.ndarray,
+    row_ends: np.ndarray,
+    flags: np.ndarray,
+    tables_bytes: bytes,
+) -> bytes:
+    """The v3 checkpoint block: the whole committed prefix as contiguous
+    column planes (minimal dtypes) + preds + per-change row ends/corrupt
+    flags + the interner tables as one JSONL blob. Loading is a handful
+    of np.frombuffer slices — no per-change parsing (the v2 record loop
+    cost a 10k-feed cold open seconds of pure Python). v2 records append
+    AFTER the checkpoint; `FileColumnStorageV2.load` replays that tail."""
+    n_changes = len(row_ends)
+    n_rows = int(row_ends[-1]) if n_changes else 0
+    return v3_frame(
+        v3_body_bytes(planes, preds, row_ends, flags),
+        n_rows, n_changes, len(preds), tables_bytes,
+    )
+
+
+def parse_v3_checkpoint(raw: bytes):
+    """(planes, preds, row_ends, flags, tables_lines, end_offset) or
+    None when `raw` does not start with a complete v3 block."""
+    if not raw.startswith(_V3_MAGIC):
+        return None
+    pos = len(_V3_MAGIC)
+    if pos + _V3_HDR.size > len(raw):
+        return None
+    n_rows, n_changes, n_preds, t_len = _V3_HDR.unpack_from(raw, pos)
+    pos += _V3_HDR.size
+    planes: Dict[str, np.ndarray] = {}
+    for name in PLANE_NAMES:
+        if pos + 1 > len(raw):
+            return None
+        code = raw[pos]
+        pos += 1
+        if code >= len(_V3_DTYPES):
+            return None
+        dt = np.dtype(_V3_DTYPES[code])
+        nbytes = n_rows * dt.itemsize
+        if pos + nbytes > len(raw):
+            return None
+        planes[name] = np.frombuffer(raw, dt, count=n_rows, offset=pos)
+        pos += nbytes
+    need = n_changes * 8 + n_changes + n_preds * 4 * PRED_FIELDS + t_len
+    if pos + need > len(raw):
+        return None
+    row_ends = np.frombuffer(raw, np.int64, count=n_changes, offset=pos)
+    pos += n_changes * 8
+    flags = np.frombuffer(raw, np.uint8, count=n_changes, offset=pos)
+    pos += n_changes
+    preds = np.frombuffer(
+        raw, np.int32, count=n_preds * PRED_FIELDS, offset=pos
+    ).reshape(-1, PRED_FIELDS)
+    pos += n_preds * 4 * PRED_FIELDS
+    tables = (
+        raw[pos : pos + t_len].decode("utf-8").splitlines()
+        if t_len
+        else []
+    )
+    pos += t_len
+    return planes, preds, row_ends, flags, tables, pos
+
+
 def pack_v2_record(
     rows: np.ndarray, preds: np.ndarray, table_lines: List[str], flag: int
 ) -> bytes:
@@ -399,7 +573,7 @@ def pack_v2_record(
 
 
 class FileColumnStorageV2:
-    """Single-file sidecar: one framed record per committed change.
+    """Single-file sidecar: optional v3 checkpoint + framed records.
 
     Record = <u32 n_rows, u32 n_preds, u32 tables_len, u8 flag>
              rows_bytes || preds_bytes || tables_bytes(jsonl)
@@ -408,7 +582,14 @@ class FileColumnStorageV2:
     overwritten by the next append. One open+read per cold load and one
     append write per change — the 4-file layout (FileColumnStorage,
     retained read-compatible for old repos) cost a bulk cold start four
-    opens + seven stats PER FEED."""
+    opens + seven stats PER FEED.
+
+    A file may START with a v3 checkpoint block (pack_v3_checkpoint):
+    the committed prefix as contiguous narrow column planes, loaded by
+    `load_v3` with a handful of frombuffer slices instead of a per-
+    change Python loop. Records after the checkpoint are the live tail;
+    `write_checkpoint` (FeedColumnCache.compact) folds them in by
+    atomically rewriting the file."""
 
     _HDR = struct.Struct("<IIIB")
 
@@ -417,11 +598,11 @@ class FileColumnStorageV2:
         self._end: Optional[int] = None  # valid end offset
         self._counts = None  # (n_rows, n_preds, n_tables) totals
 
-    def _parse(self, raw: bytes):
+    def _parse_from(self, raw: bytes, start: int):
         """(records, valid_end): records are (n_rows, n_preds, tables
-        slice, flag, rows slice, preds slice)."""
+        slice, flag, rows slice, preds slice), parsed from `start`."""
         out = []
-        pos = 0
+        pos = start
         end = len(raw)
         h = self._HDR
         while pos + h.size <= end:
@@ -434,13 +615,63 @@ class FileColumnStorageV2:
             pos += h.size + body
         return out, pos
 
-    def load(self):
+    def load_v3(self):
+        """(base_planes|None, tail_rows, preds, tables, commits,
+        n_tail_records): the checkpoint (when present) plus the v2 tail
+        after it. Base commits synthesize [row_end, 0, 0, flag] rows —
+        only columns 0 and 3 feed FeedColumns."""
         try:
             with open(self.path, "rb") as fh:
                 raw = fh.read()
         except OSError:
             raw = b""
-        recs, valid_end = self._parse(raw)
+        ck = parse_v3_checkpoint(raw)
+        if ck is None:
+            rows, preds, tables, commits = self._load_v2(raw, 0)
+            return None, rows, preds, tables, commits, len(commits)
+        planes, preds_ck, row_ends, flags, tables_ck, off = ck
+        t_rows, t_preds, t_tables, t_commits = self._load_v2(raw, off)
+        n_base_rows = int(row_ends[-1]) if len(row_ends) else 0
+        commits = np.zeros(
+            (len(row_ends) + len(t_commits), COMMIT_FIELDS), np.int32
+        )
+        commits[: len(row_ends), 0] = row_ends
+        commits[: len(row_ends), 3] = flags
+        if len(t_commits):
+            commits[len(row_ends) :] = t_commits
+            commits[len(row_ends) :, 0] += n_base_rows
+            commits[len(row_ends) :, 1] += len(preds_ck)
+        preds = (
+            np.concatenate([preds_ck, t_preds], axis=0)
+            if len(t_preds)
+            else preds_ck
+        )
+        self._counts = (
+            n_base_rows + len(t_rows),
+            len(preds),
+            len(tables_ck) + len(t_tables),
+        )
+        return (
+            planes, t_rows, preds, tables_ck + t_tables, commits,
+            len(t_commits),
+        )
+
+    def load(self):
+        """Legacy whole-rows entry: delegates to load_v3 and widens any
+        checkpoint planes into the dense row matrix."""
+        planes, t_rows, preds, tables, commits, _ = self.load_v3()
+        if planes is None:
+            return t_rows, preds, tables, commits
+        base = rows_from_planes(planes)
+        rows = (
+            np.concatenate([base, t_rows], axis=0)
+            if len(t_rows)
+            else base
+        )
+        return rows, preds, tables, commits
+
+    def _load_v2(self, raw: bytes, start: int):
+        recs, valid_end = self._parse_from(raw, start)
         self._end = valid_end
         rows_parts = []
         pred_parts = []
@@ -493,6 +724,29 @@ class FileColumnStorageV2:
             fh.truncate()
             fh.flush()
         self._end = end + len(rec)
+
+    def write_checkpoint(
+        self,
+        planes: Dict[str, np.ndarray],
+        preds: np.ndarray,
+        row_ends: np.ndarray,
+        flags: np.ndarray,
+        tables_bytes: bytes,
+    ) -> None:
+        """Atomically replace the file with a checkpoint covering the
+        whole committed state (tmp + rename: a crash leaves either the
+        old file or the new one, never a hybrid)."""
+        blob = pack_v3_checkpoint(
+            planes, preds, row_ends, flags, tables_bytes
+        )
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        self._end = len(blob)
 
     def reset(self) -> None:
         if os.path.exists(self.path):
@@ -576,21 +830,41 @@ class FeedColumnCache:
         self._floats = _Interner()
         self._bigints = _Interner()
         self._pending_tables = []
-        rows, preds, tables, commits = self._storage.load()
+        self._base_planes: Optional[Dict[str, np.ndarray]] = None
+        n_tail = 0
+        lv3 = getattr(self._storage, "load_v3", None)
+        if lv3 is not None:
+            (
+                self._base_planes, rows, preds, tables, commits, n_tail,
+            ) = lv3()
+        else:
+            rows, preds, tables, commits = self._storage.load()
         self._apply_tables(tables)
         if self.writer not in self._actors:
             # fresh cache: actor 0 is the writer (the table line flushes
             # with the first commit)
             self._intern("a", self._actors, self.writer)
+        self._base_rows = (
+            len(self._base_planes["action"])
+            if self._base_planes is not None
+            else 0
+        )
         self._row_chunks: List[np.ndarray] = [rows] if len(rows) else []
         self._pred_chunks: List[np.ndarray] = [preds] if len(preds) else []
-        self._n_rows_total = len(rows)
+        self._n_rows_total = self._base_rows + len(rows)
         self._n_preds_total = len(preds)
         self._commits_arr: np.ndarray = np.asarray(
             commits, np.int32
         ).reshape(-1, COMMIT_FIELDS)
         self._commits_new: List[Tuple[int, int, int, int]] = []
         self._cached: Optional[FeedColumns] = None
+        # long v2 tails re-pay the per-record parse on every cold load:
+        # fold them into the checkpoint now (atomic rewrite)
+        if n_tail >= int(os.environ.get("HM_CKPT_TAIL", "64")):
+            try:
+                self.compact()
+            except OSError:  # read-only media: served from memory fine
+                pass
 
     # -- table interning ----------------------------------------------
 
@@ -744,6 +1018,8 @@ class FeedColumnCache:
         with self._lock:
             self._loaded = True  # reset state IS the loaded-fresh state
             self._storage.reset()
+            self._base_planes = None
+            self._base_rows = 0
             self._actors = _Interner()
             self._keys = _Interner()
             self._strings = _Interner()
@@ -764,12 +1040,28 @@ class FeedColumnCache:
             self._ensure_loaded()
             if self._cached is not None:
                 return self._cached
+            planes = None
+            if self._base_planes is not None:
+                if not self._row_chunks:
+                    planes = self._base_planes  # pure checkpoint load
+                else:
+                    # live appends landed after the checkpoint: fold the
+                    # planes into dense rows once and continue row-wise
+                    self._row_chunks.insert(
+                        0, rows_from_planes(self._base_planes)
+                    )
+                    self._base_planes = None
+                    self._base_rows = 0
             rows = (
                 self._row_chunks[0]
                 if len(self._row_chunks) == 1  # no-copy: fresh load
                 else np.concatenate(self._row_chunks, axis=0)
                 if self._row_chunks
-                else np.zeros((0, ROW_FIELDS), np.int32)
+                else (
+                    None
+                    if planes is not None
+                    else np.zeros((0, ROW_FIELDS), np.int32)
+                )
             )
             preds = (
                 self._pred_chunks[0]
@@ -778,7 +1070,9 @@ class FeedColumnCache:
                 if self._pred_chunks
                 else np.zeros((0, PRED_FIELDS), np.int32)
             )
-            self._row_chunks = [rows] if len(rows) else []
+            self._row_chunks = (
+                [rows] if rows is not None and len(rows) else []
+            )
             self._pred_chunks = [preds] if len(preds) else []
             if self._commits_new:
                 self._commits_arr = np.concatenate(
@@ -809,8 +1103,48 @@ class FeedColumnCache:
                 n_changes=n,
                 ok_prefix_len=ok_prefix,
                 row_ends=row_ends,
+                planes=planes,
             )
             return self._cached
+
+    def compact(self) -> None:
+        """Fold the storage's whole committed state into one v3
+        checkpoint (atomic rewrite). Cold loads of a compacted feed are
+        a handful of frombuffer slices; v2 tails re-accumulate with
+        live appends until the next compaction (auto at load when the
+        tail exceeds HM_CKPT_TAIL records)."""
+        with self._lock:
+            self._ensure_loaded()
+            wc = getattr(self._storage, "write_checkpoint", None)
+            if wc is None:
+                return
+            fc = self.columns()
+            if fc.planes is not None:
+                planes = fc.planes
+            else:
+                planes = planes_from_rows(fc.ensure_rows())
+            commits = self._commits_arr
+            wc(
+                planes,
+                fc.preds,
+                commits[:, 0].astype(np.int64),
+                commits[:, 3].astype(np.uint8),
+                self._tables_blob(),
+            )
+
+    def _tables_blob(self) -> bytes:
+        lines = []
+        for kind, interner in (
+            ("a", self._actors), ("k", self._keys),
+            ("s", self._strings), ("f", self._floats),
+            ("b", self._bigints),
+        ):
+            for v in interner.items:
+                jv = str(v) if kind == "b" else v
+                lines.append(
+                    json.dumps({"t": kind, "v": jv}, separators=(",", ":"))
+                )
+        return ("\n".join(lines) + "\n").encode("utf-8") if lines else b""
 
     def destroy(self) -> None:
         """Delete the cache's persisted state entirely (doc destroy)."""

@@ -33,6 +33,30 @@ def _clamp(seq: float) -> int:
 class ClockStore:
     def __init__(self, db: SqlDatabase) -> None:
         self.db = db
+        self.mirror = None  # optional DeviceClockMirror (attach_mirror)
+        self._mirror_repo: Optional[str] = None
+
+    def attach_mirror(self, repo_id: str, mirror) -> None:
+        """Keep a DeviceClockMirror (ops/clock_mirror.py) consistent
+        with every clock write FOR ONE REPO, seeding it with the
+        existing rows; whole-corpus union/dominated queries then run as
+        single dispatches over the device-resident matrix instead of
+        sqlite scans + re-uploads. Writes scoped to other repo ids
+        sharing this database never touch the mirror (set() is a hard
+        per-repo overwrite — merging repos would corrupt it)."""
+        rows = self.db.query(
+            "SELECT doc_id, actor_id, seq FROM clocks WHERE repo_id=?",
+            (repo_id,),
+        )
+        by_doc: Dict[str, clockmod.Clock] = {}
+        for doc_id, actor, seq in rows:
+            by_doc.setdefault(doc_id, {})[actor] = seq
+        mirror.update_many(by_doc)
+        self.mirror = mirror
+        self._mirror_repo = repo_id
+
+    def _mirror_for(self, repo_id: str):
+        return self.mirror if repo_id == self._mirror_repo else None
 
     def get(self, repo_id: str, doc_id: str) -> clockmod.Clock:
         rows = self.db.query(
@@ -73,6 +97,9 @@ class ClockStore:
                 for a, s in clock.items()
             ],
         )
+        m = self._mirror_for(repo_id)
+        if m is not None:
+            m.update(doc_id, clock)
         return self.get(repo_id, doc_id)
 
     def update_many(
@@ -91,6 +118,9 @@ class ClockStore:
                 for a, s in clock.items()
             ],
         )
+        m = self._mirror_for(repo_id)
+        if m is not None:
+            m.update_many(clocks)
 
     def set(
         self, repo_id: str, doc_id: str, clock: clockmod.Clock
@@ -105,10 +135,15 @@ class ClockStore:
             "VALUES (?,?,?,?)",
             [(repo_id, doc_id, a, _clamp(s)) for a, s in clock.items()],
         )
+        m = self._mirror_for(repo_id)
+        if m is not None:
+            m.set(doc_id, clock)
 
     def delete_doc(self, doc_id: str) -> None:
         """Drop every repo's clock rows for a doc (doc destroy)."""
         self.db.execute("DELETE FROM clocks WHERE doc_id=?", (doc_id,))
+        if self.mirror is not None:  # destroy is cross-repo by design
+            self.mirror.delete_doc(doc_id)
 
     def all_doc_ids(self, repo_id: str) -> List[str]:
         return [
@@ -134,7 +169,12 @@ class ClockStore:
     def union_query(
         self, repo_id: str, doc_ids: Optional[List[str]] = None
     ) -> clockmod.Clock:
-        """Union of many docs' clocks in one device reduction."""
+        """Union of many docs' clocks in one device reduction. With a
+        mirror attached, the whole-corpus form never touches sqlite —
+        the matrix is already device-resident."""
+        m = self._mirror_for(repo_id)
+        if m is not None and doc_ids is None:
+            return m.union()
         ids = doc_ids if doc_ids is not None else self.all_doc_ids(repo_id)
         if not ids:
             return {}
@@ -150,7 +190,11 @@ class ClockStore:
         self, repo_id: str, query: clockmod.Clock,
         doc_ids: Optional[List[str]] = None,
     ) -> List[str]:
-        """All docs whose clock is dominated by `query` (one dispatch)."""
+        """All docs whose clock is dominated by `query` (one dispatch;
+        device-resident when a mirror is attached)."""
+        m = self._mirror_for(repo_id)
+        if m is not None and doc_ids is None:
+            return m.dominated(query)
         ids = doc_ids if doc_ids is not None else self.all_doc_ids(repo_id)
         if not ids:
             return []

@@ -290,3 +290,53 @@ def test_bulk_snapshot_fn_released_after_first_ready(tmp_path):
         assert doc.opset is None  # still lazy
     finally:
         repo2.close()
+
+
+def test_noop_change_does_not_strand_queue():
+    """ADVICE r5 low (doc_frontend.py): when the echo-paced queue pops a
+    change fn that produces no ops, the drain must continue to the next
+    queued change instead of stranding until an unrelated patch."""
+    from hypermerge_tpu.frontend.doc_frontend import DocFrontend
+
+    sent = []
+
+    class StubRepo:
+        class to_backend:
+            @staticmethod
+            def push(msg):
+                pass
+
+        @staticmethod
+        def send_request(doc_id, request):
+            sent.append(request)
+
+        @staticmethod
+        def needs_actor(doc_id):
+            pass
+
+    doc_id = "d" * 43
+    fe = DocFrontend(StubRepo(), doc_id, actor_id=doc_id)
+
+    fe.change(lambda d: d.__setitem__("a", 1))
+    assert len(sent) == 1 and fe._inflight is not None
+
+    # queue while the echo is outstanding: a no-op fn, then a real one
+    fe.change(lambda d: None)
+    fe.change(lambda d: d.__setitem__("b", 2))
+    assert len(sent) == 1  # both queued behind the in-flight echo
+
+    # the echo lands: the no-op pops (produces nothing) and the drain
+    # must continue to the real change in the same pass
+    req = sent[0]
+    fe.on_patch(
+        {
+            "actor": req.actor,
+            "seq": req.seq,
+            "diffs": [],
+            "deps": {},
+            "maxOp": 1,
+            "clock": {req.actor: req.seq},
+        },
+        1,
+    )
+    assert len(sent) == 2, "queued change stranded behind a no-op fn"

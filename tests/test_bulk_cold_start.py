@@ -545,3 +545,107 @@ def test_open_many_lazy_handles():
         handles2 = repo2.open_many(urls[:2])
         assert plainify(handles2[0].value()) == want[urls[0]]
         repo2.close()
+
+
+class TestV3Checkpoint:
+    """v3 plane checkpoints (storage/colcache.py): one frombuffer load,
+    v2 tail replay, auto-compaction, torn-write safety."""
+
+    def _cc(self, tmp_path, name="feedX"):
+        from hypermerge_tpu.storage.colcache import FileColumnStorageV2
+
+        return FeedColumnCache(
+            FileColumnStorageV2(str(tmp_path / name)), writer="actor00"
+        )
+
+    def test_checkpoint_roundtrip_planes(self, tmp_path):
+        _site, history = _history(3, n_actors=1, n_mut=20)
+        cc = self._cc(tmp_path)
+        for c in sorted(history, key=lambda c: (c.actor, c.seq)):
+            cc.append_change(c)
+        want = cc.columns()
+        cc.compact()
+
+        cc2 = self._cc(tmp_path)
+        got = cc2.columns()
+        assert got.planes is not None  # plane-backed load
+        assert np.array_equal(got.ensure_rows(), want.ensure_rows())
+        assert np.array_equal(got.preds, want.preds)
+        assert got.actors == want.actors and got.keys == want.keys
+        assert got.n_changes == want.n_changes
+        assert np.array_equal(got.row_ends, want.row_ends)
+
+    def test_tail_after_checkpoint_merges(self, tmp_path):
+        _site, history = _history(4, n_actors=1, n_mut=30)
+        history = sorted(history, key=lambda c: (c.actor, c.seq))
+        half = len(history) // 2
+        cc = self._cc(tmp_path)
+        for c in history[:half]:
+            cc.append_change(c)
+        cc.compact()
+        for c in history[half:]:
+            cc.append_change(c)  # v2 records after the checkpoint
+
+        ref = FeedColumnCache(MemoryColumnStorage(), writer="actor00")
+        for c in history:
+            ref.append_change(c)
+
+        cc2 = self._cc(tmp_path)
+        got, want = cc2.columns(), ref.columns()
+        assert np.array_equal(got.ensure_rows(), want.ensure_rows())
+        assert np.array_equal(got.preds, want.preds)
+        assert got.n_changes == want.n_changes
+
+    def test_auto_compaction_folds_long_tails(self, tmp_path, monkeypatch):
+        from hypermerge_tpu.storage.colcache import parse_v3_checkpoint
+
+        monkeypatch.setenv("HM_CKPT_TAIL", "8")
+        _site, history = _history(5, n_actors=1, n_mut=30)
+        history = sorted(history, key=lambda c: (c.actor, c.seq))
+        cc = self._cc(tmp_path)
+        for c in history:
+            cc.append_change(c)
+        want_rows = cc.columns().ensure_rows().copy()
+        assert len(history) >= 8
+
+        cc2 = self._cc(tmp_path)  # load triggers auto-compact
+        assert np.array_equal(cc2.columns().ensure_rows(), want_rows)
+        raw = (tmp_path / "feedX").read_bytes()
+        ck = parse_v3_checkpoint(raw)
+        assert ck is not None and ck[5] == len(raw)  # no v2 tail left
+
+    def test_torn_checkpoint_falls_back(self, tmp_path):
+        """A truncated checkpoint (crash mid-rewrite never leaves one —
+        rename is atomic — but disk corruption might) must load as
+        empty, not crash; blocks are the source of truth."""
+        _site, history = _history(6, n_actors=1, n_mut=15)
+        cc = self._cc(tmp_path)
+        for c in sorted(history, key=lambda c: (c.actor, c.seq)):
+            cc.append_change(c)
+        cc.compact()
+        raw = (tmp_path / "feedX").read_bytes()
+        (tmp_path / "feedX").write_bytes(raw[: len(raw) // 2])
+
+        cc2 = self._cc(tmp_path)
+        got = cc2.columns()
+        assert got.n_changes == 0 and got.n_rows == 0
+
+    def test_append_after_plane_load(self, tmp_path):
+        """Live appends on a checkpoint-loaded cache fold planes into
+        rows and keep going (the interactive-writer path)."""
+        _site, history = _history(7, n_actors=1, n_mut=25)
+        history = sorted(history, key=lambda c: (c.actor, c.seq))
+        cc = self._cc(tmp_path)
+        for c in history[:-3]:
+            cc.append_change(c)
+        cc.compact()
+        cc2 = self._cc(tmp_path)
+        assert cc2.columns().planes is not None
+        for c in history[-3:]:
+            cc2.append_change(c)
+        ref = FeedColumnCache(MemoryColumnStorage(), writer="actor00")
+        for c in history:
+            ref.append_change(c)
+        assert np.array_equal(
+            cc2.columns().ensure_rows(), ref.columns().ensure_rows()
+        )
