@@ -107,6 +107,25 @@ class RepoBackend:
         # the deferral accumulators above are per-load state
         self._pending_summaries: List = []
         self.last_bulk_stats: Dict[str, int] = {}
+        # cursor/clock gossip is a latest-state broadcast: debounce it
+        # so a burst of local changes to one doc costs one frame
+        from ..utils.debounce import Debouncer
+
+        self._gossip = Debouncer(
+            self._flush_gossip,
+            window_s=float(os.environ.get("HM_GOSSIP_FLUSH_MS", "10"))
+            / 1e3,
+            name="gossip",
+        )
+        # inbound-sync application is idempotent window-polling: under
+        # edit load many small extensions coalesce into one
+        # _sync_changes pass per actor
+        self._syncs = Debouncer(
+            self._flush_syncs,
+            window_s=float(os.environ.get("HM_SYNC_FLUSH_MS", "2"))
+            / 1e3,
+            name="syncs",
+        )
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -818,7 +837,12 @@ class RepoBackend:
                     if event.get("origin") == "append":
                         self._bulk_deferred_syncs.add(actor.id)
                     return
-            self._sync_changes(actor)
+            if event.get("origin") == "append":
+                # replicated appends arrive in bursts: coalesce the
+                # idempotent window-application per actor
+                self._syncs.mark(actor.id)
+            else:
+                self._sync_changes(actor)
         elif t == "Download":
             for doc_id in self.cursors.docs_with_actor(self.id, actor.id):
                 self.to_frontend.push(
@@ -909,12 +933,41 @@ class RepoBackend:
             )
 
     def _gossip_cursor(self, doc: DocBackend) -> None:
-        if self.network is not None:
+        self._gossip.mark(doc.id)
+
+    def _flush_gossip(self, doc_ids) -> None:
+        if self.network is None or self._closed:
+            return
+        for doc_id in doc_ids:
             self.network.gossip_cursor(
-                doc.id,
-                self.cursors.get(self.id, doc.id),
-                self.clocks.get(self.id, doc.id),
+                doc_id,
+                self.cursors.get(self.id, doc_id),
+                self.clocks.get(self.id, doc_id),
             )
+
+    def _announce_file_feed(self, feed) -> None:
+        """File feeds replicate like any feed (reference
+        src/ReplicationManager.ts:71-89): persist + join + announce so
+        peers holding (or wanting) the file can sync it."""
+        self._save_feed_info(feed)
+        if self.network is not None:
+            self.network.announce_feed(feed)
+
+    def get_file_store(self) -> FileStore:
+        """The repo's FileStore, swarm-wired for remote fetch; created
+        on first use (with or without an HTTP file server)."""
+        if self.file_store is None:
+            self.file_store = FileStore(
+                self.feeds, announce=self._announce_file_feed
+            )
+            # Completed uploads flow into the durable metadata ledger
+            # (reference src/RepoBackend.ts:105-107 → Metadata.addFile).
+            self.file_store.write_log.subscribe(
+                lambda header: self.meta.add_file(
+                    header.url, header.size, header.mime_type
+                )
+            )
+        return self.file_store
 
     def start_file_server(self, path: str) -> None:
         from ..files.file_server import FileServer
@@ -923,14 +976,7 @@ class RepoBackend:
             raise RuntimeError(
                 "file server already listening; one per repo backend"
             )
-        self.file_store = FileStore(self.feeds)
-        # Completed uploads flow into the durable metadata ledger
-        # (reference src/RepoBackend.ts:105-107 → Metadata.addFile).
-        self.file_store.write_log.subscribe(
-            lambda header: self.meta.add_file(
-                header.url, header.size, header.mime_type
-            )
-        )
+        self.get_file_store()
         self._file_server = FileServer(self.file_store)
         self._file_server.listen(path)
         self.to_frontend.push(msgs.file_server_ready_msg(path))
@@ -944,8 +990,18 @@ class RepoBackend:
 
     # ------------------------------------------------------------------
 
+    def _flush_syncs(self, actor_ids) -> None:
+        if self._closed:
+            return
+        for actor_id in actor_ids:
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                self._sync_changes(actor)
+
     def close(self) -> None:
         self._closed = True
+        self._gossip.close()
+        self._syncs.close()
         if self._file_server is not None:
             self._file_server.close()
             self._file_server = None

@@ -221,6 +221,7 @@ class Network:
     # ------------------------------------------------------------------
 
     def close(self) -> None:
+        self.replication.close()
         for peer in list(self.peers.values()):
             peer.close()
         self.peers.clear()

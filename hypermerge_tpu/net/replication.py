@@ -30,8 +30,13 @@ Backfill chunking: a sender slices at its stored signature records
 (HM_REPL_CHUNK blocks per chunk, default 1024). Unsigned legacy blocks
 are dropped unless HM_ALLOW_UNSIGNED_FEEDS=1.
 
-Live tail: local appends push one signed Blocks msg to every peer
-replicating the feed.
+Live tail: local appends mark the feed dirty; a flusher thread
+coalesces every append that lands within one flush window
+(HM_REPL_FLUSH_MS, default 2ms) into ONE signed Blocks msg per feed —
+a burst of N interactive edits costs O(1) frames, not N (the batched
+block sync of hypercore-protocol; reference
+src/ReplicationManager.ts:114-136). Frames still respect the
+chunk block/byte budgets via _pick_boundary.
 """
 
 from __future__ import annotations
@@ -60,6 +65,14 @@ def _chunk_bytes() -> int:
     return int(os.environ.get("HM_REPL_CHUNK_BYTES", str(8 * 1024 * 1024)))
 
 
+def _flush_window_s() -> float:
+    return float(os.environ.get("HM_REPL_FLUSH_MS", "2")) / 1e3
+
+
+def _flush_window_max_s() -> float:
+    return float(os.environ.get("HM_REPL_FLUSH_MAX_MS", "25")) / 1e3
+
+
 class ReplicationManager:
     def __init__(
         self,
@@ -83,6 +96,18 @@ class ReplicationManager:
         # must prove against) and theirs (what we prove against)
         self._challenge_local: Dict[NetworkPeer, bytes] = {}
         self._challenge_remote: Dict[NetworkPeer, bytes] = {}
+        # live-tail coalescing: public_key -> earliest unflushed block,
+        # adaptive window (batches grow under sustained load instead of
+        # frame count), drained on close
+        from ..utils.debounce import Debouncer
+
+        self._flusher = Debouncer(
+            self._flush_batch,
+            window_s=_flush_window_s(),
+            max_window_s=_flush_window_max_s(),
+            merge=min,
+            name="repl-flush",
+        )
 
     # ------------------------------------------------------------------
 
@@ -299,15 +324,22 @@ class ReplicationManager:
 
     def _pick_boundary(self, feed: Feed, start: int) -> int:
         """End of the next backfill chunk, bounded in BLOCKS and BYTES
-        (a frame must stay far below tcp.py's 64MB cap): the largest
-        signed-record length within both budgets, else the first record
-        past `start`, else the head (legacy unsigned feeds)."""
+        (a frame must stay far below tcp.py's 64MB cap). A feed we hold
+        the secret key of can sign ANY boundary on demand
+        (integrity.record_for), so the budgeted end is used directly;
+        otherwise the largest STORED signed-record length within both
+        budgets, else the first record past `start`, else the head
+        (legacy unsigned feeds)."""
         have = feed.length
         if feed.integrity is None:
             return have
-        lengths = [r[0] for r in feed.integrity.records() if r[0] > start]
-        if not lengths:
-            return have
+        writable = feed.secret_key is not None
+        if not writable:
+            lengths = [
+                r[0] for r in feed.integrity.records() if r[0] > start
+            ]
+            if not lengths:
+                return have
         # shrink the block budget until the byte budget holds
         want = min(have, start + _chunk_blocks())
         budget = _chunk_bytes()
@@ -320,6 +352,8 @@ class ReplicationManager:
                 count -= 1
                 break
         want = start + max(count, 1)
+        if writable:
+            return want
         within = [l for l in lengths if l <= want]
         if within:
             return max(within)
@@ -334,7 +368,7 @@ class ReplicationManager:
 
     def _blocks_msg(self, feed: Feed, did: str, start: int, end: int):
         rec = (
-            feed.integrity.record_at(end)
+            feed.integrity.record_for(feed, end)
             if feed.integrity is not None
             else None
         )
@@ -430,32 +464,64 @@ class ReplicationManager:
             if feed.public_key in self._tailed:
                 return
             self._tailed.add(feed.public_key)
-        did = feed.discovery_id
 
         def on_extended(start: int, end: int) -> None:
-            # one push per extension (a verified backfill chunk is ONE
-            # event, not per-block) — relays don't amplify chunk traffic
+            # mark dirty and let the flusher coalesce: a burst of
+            # appends within one flush window rides ONE signed frame
+            self._flusher.mark(feed.public_key, start)
+
+        feed.on_extended(on_extended)
+
+    def _flush_batch(self, batch: Dict[str, int]) -> None:
+        for pk, start in batch.items():
+            feed = self.feeds.get_feed(pk)
+            if feed is None:
+                continue
+            try:
+                self._flush_feed(feed, start)
+            except Exception as e:  # a bad feed must not kill tails
+                log("replication", f"tail flush failed {pk[:6]}: {e}")
+
+    def _flush_feed(self, feed: Feed, start: int) -> None:
+        did = feed.discovery_id
+        peers = self.peers_with_feed(did)
+        if not peers:
+            return
+        head = feed.length
+        while start < head:
+            # _pick_boundary keeps each frame inside the chunk block +
+            # byte budgets even when a window coalesced a huge range
+            end = self._pick_boundary(feed, start)
             rec = (
-                feed.integrity.record_at(end)
+                feed.integrity.record_for(feed, end)
                 if feed.integrity is not None
                 else None
             )
-            if rec is not None:
-                payload = self._blocks_msg(feed, did, start, end)
-                for peer in self.peers_with_feed(did):
-                    self._send(peer, payload)
-            else:
-                # no signature at this exact length: announce and let
+            if rec is None:
+                # no signature at this length (mid-chunk race on a
+                # relayed feed, or unsigned legacy): announce and let
                 # peers pull a chunk we CAN sign for. Built per peer so
                 # each frame carries that peer's capability proof —
                 # receivers run _check_cap on every FeedLength, and
                 # already-verified peers short-circuit either way
-                for peer in self.peers_with_feed(did):
+                for peer in peers:
                     msg = self._feed_length_msg(feed, peer)
                     if msg is not None:
                         self._send(peer, msg)
+                return
+            payload = self._blocks_msg(feed, did, start, end)
+            for peer in peers:
+                self._send(peer, payload)
+            start = end
 
-        feed.on_extended(on_extended)
+    def flush_now(self, timeout: float = 5.0) -> None:
+        """Block until every currently-dirty tail has FINISHED
+        flushing (tests and orderly shutdown)."""
+        self._flusher.flush_now(timeout)
+
+    def close(self) -> None:
+        # drains: tails marked before close still reach peers
+        self._flusher.close()
 
     def _send(self, peer: NetworkPeer, msg: Dict) -> None:
         if peer.is_connected:

@@ -42,9 +42,15 @@ def pack(obj: Any) -> bytes:
     return pack_raw(bufferify(obj))
 
 
+_MIN_COMPRESS = 512  # tiny interactive blocks: framing+cpu beats the
+# handful of saved bytes, store raw JSON
+
+
 def pack_raw(raw: bytes) -> bytes:
     """Pack already-serialized JSON bytes (callers that template/replay
     serialized changes skip the re-serialization)."""
+    if len(raw) < _MIN_COMPRESS:
+        return raw
     if _use_brotli():
         compressed = native.compress(
             native.CODEC_BROTLI, raw, quality=_BR_QUALITY

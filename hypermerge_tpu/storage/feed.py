@@ -285,11 +285,27 @@ class Feed:
             cb(indices[0], length)
         return True
 
+    def seal(self) -> None:
+        """Persist a signed record at the current head. Live appends
+        sign lazily (storage/integrity.py sign_interval); seal closes
+        the gap so the on-disk chain covers every block — called on
+        close and before audit."""
+        if self.integrity is not None and self.writable and self.length:
+            self.integrity.record_for(self, self.length)
+
     def audit(self) -> bool:
-        """Re-hash the whole block log against the newest signed record
-        (on-disk tamper detection). True for an empty unsigned feed."""
+        """Re-hash the whole block log against the signed record chain
+        (on-disk tamper detection). True for an empty unsigned feed.
+
+        Sealing first happens ONLY for a tail this process itself
+        appended (unsigned_tail — inside the local trust boundary). A
+        tail found on disk beyond the last record — crash leftovers or
+        an attacker's append — must FAIL the audit, never be signed
+        into validity."""
         if self.integrity is None:
             return False
+        if self.writable and self.integrity.unsigned_tail:
+            self.seal()
         return self.integrity.audit(self)
 
     def _append_raw(self, data: bytes) -> int:
@@ -323,9 +339,19 @@ class Feed:
         with self._lock:
             self._append_listeners.append(cb)
 
+    def off_append(self, cb: Callable[[int, bytes], None]) -> None:
+        with self._lock:
+            if cb in self._append_listeners:
+                self._append_listeners.remove(cb)
+
     def on_extended(self, cb: Callable[[int, int], None]) -> None:
         with self._lock:
             self._extend_listeners.append(cb)
+
+    def off_extended(self, cb: Callable[[int, int], None]) -> None:
+        with self._lock:
+            if cb in self._extend_listeners:
+                self._extend_listeners.remove(cb)
 
     def destroy(self) -> None:
         """Delete everything this feed persisted: block log, columnar
@@ -341,6 +367,8 @@ class Feed:
             self._storage.close()
 
     def close(self) -> None:
+        if self.integrity is not None and self.integrity.unsigned_tail:
+            self.seal()
         if self.colcache is not None:
             self.colcache.close()
         self._storage.close()

@@ -15,11 +15,16 @@ Design (TPU-irrelevant, host-side, but built for the bulk scale):
   is O(log n) hashing, not O(n) — equivalence with the bulk recompute is
   pinned by tests/test_integrity.py.
 - signature = ed25519(seed, b"hm-feed-v1" || uint64le(length) || root),
-  one record per append: (length, root, sig). Records persist in a
-  `.sig` sidecar next to the block log (104-byte fixed records; a torn
-  tail truncates to the last whole record). Only the newest record is
-  needed to verify a full prefix; per-append records let a writer serve
-  a signature for ANY chunk boundary when streaming backfill.
+  records (length, root, sig) persist in a `.sig` sidecar next to the
+  block log (104-byte fixed records; a torn tail truncates to the last
+  whole record). Only the newest record is needed to verify a full
+  prefix. A live writer signs PERIODICALLY (every HM_SIGN_INTERVAL
+  appends, default 1024 — the replication chunk size) plus ON DEMAND at
+  any boundary via record_for (the incremental peaks give the head root
+  for free; older boundaries recompute from the cached leaves), so an
+  interactive burst of appends costs one signature per replication
+  flush, not one per append. The dense-record corpus format
+  (sign_chain) remains valid input: record_for prefers stored records.
 - replication (net/replication.py) verifies every inbound extension:
   recompute root over (own leaves[0:start] + received blocks) and check
   the sender's signature against the feed public key BEFORE _append_raw.
@@ -48,6 +53,10 @@ _SIG_CONTEXT = b"hm-feed-v1"
 _REC = struct.Struct("<Q32s64s")  # length, root, signature
 
 _NODE_PREFIX = b"\x01"
+
+
+def sign_interval() -> int:
+    return int(os.environ.get("HM_SIGN_INTERVAL", "1024"))
 
 
 def _parent(left: bytes, right: bytes) -> bytes:
@@ -169,6 +178,9 @@ class FeedIntegrity:
         self._records: Optional[List[Tuple[int, bytes, bytes]]] = None
         self._peaks: Optional[Peaks] = None
         self._leaves: List[bytes] = []
+        # appends this session not yet covered by a stored record
+        # (periodic signing skipped them) — Feed.close/seal signs then
+        self.unsigned_tail = False
 
     # -- records --------------------------------------------------------
 
@@ -223,19 +235,68 @@ class FeedIntegrity:
     # -- writer path ------------------------------------------------------
 
     def sign_append(self, feed, index: int, data: bytes) -> None:
-        """Writer appended block `index`: extend the tree and store a
-        fresh signed record. Requires the feed's secret key."""
-        seed = keymod.decode(feed.secret_key)
+        """Writer appended block `index`: extend the tree, and store a
+        fresh signed record every sign_interval appends (any other
+        boundary signs on demand in record_for — per-append ed25519 +
+        sidecar IO is the dominant cost of an interactive write)."""
         with self._lock:
             peaks = self._ensure_peaks(feed, index)
             leaf = crypto.leaf_hash(data)
             if len(self._leaves) == index:
                 self._leaves.append(leaf)
             peaks.append(leaf)
-            root = peaks.root()
-            sig = crypto.sign(signable(index + 1, root), seed)
-            self._ensure_records().append((index + 1, root, sig))
-            self._store.append(index + 1, root, sig)
+            if (index + 1) % sign_interval() == 0:
+                root = peaks.root()
+                sig = crypto.sign(
+                    signable(index + 1, root),
+                    keymod.decode(feed.secret_key),
+                )
+                self._ensure_records().append((index + 1, root, sig))
+                self._store.append(index + 1, root, sig)
+                self.unsigned_tail = False
+            else:
+                self.unsigned_tail = True
+
+    def record_for(self, feed, length: int):
+        """The (length, root, sig) covering exactly `length`: a stored
+        record when one exists, else — for a feed we hold the secret key
+        of — a freshly signed one. At the head the incremental peaks
+        yield the root directly (the live-tail flush path: one signature
+        per flush window); older boundaries recompute from the cached
+        leaf hashes. Newly signed head records persist; off-head ones
+        are served without storing (the sidecar stays sorted).
+
+        Lock order: feed lock BEFORE integrity lock — the same order
+        the writer path uses (Feed.append -> sign_append), so a flusher
+        signing on demand cannot deadlock against a concurrent append.
+        """
+        rec = self.record_at(length)
+        if rec is not None:
+            return rec
+        if feed.secret_key is None or length <= 0:
+            return None
+        with feed._lock:
+            if length > feed.length:
+                return None
+            seed = keymod.decode(feed.secret_key)
+            with self._lock:
+                peaks = self._ensure_peaks(feed, length)
+                if peaks.length == length:
+                    root = peaks.root()
+                else:  # boundary behind the head: rebuild to length
+                    probe = Peaks()
+                    for leaf in self._ensure_leaves(feed, length):
+                        probe.append(leaf)
+                    root = probe.root()
+                sig = crypto.sign(signable(length, root), seed)
+                rec = (length, root, sig)
+                recs = self._ensure_records()
+                if not recs or recs[-1][0] < length:
+                    recs.append(rec)
+                    self._store.append(length, root, sig)
+                    if length == feed.length:
+                        self.unsigned_tail = False
+                return rec
 
     # -- replication boundary ---------------------------------------------
 
@@ -306,8 +367,11 @@ class FeedIntegrity:
         if not recs:
             return feed.length == 0
         last_len = recs[-1][0]
-        if last_len > feed.length:
-            return False  # records claim more than the log holds
+        if last_len != feed.length:
+            # records claim more than the log holds, OR the log holds
+            # blocks no record covers (crash leftovers / foreign
+            # appends under lazy signing) — either way unverifiable
+            return False
         wanted = {length for length, _r, _s in recs}
         blocks = feed.get_batch(0, last_len)
         peaks = Peaks()

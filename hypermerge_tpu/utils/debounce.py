@@ -1,0 +1,111 @@
+"""Keyed debouncer: coalesce bursts of per-key events into one flush.
+
+Used for idempotent latest-state broadcasts (cursor/clock gossip,
+inbound-sync application — backend/repo_backend.py) and for the
+replication live tail (net/replication.py), which marks keys with a
+VALUE (the earliest dirty block offset) merged across a burst.
+
+Semantics:
+- flush_fn(batch) receives a dict {key: value}; marks landing during
+  the window (or while a flush is running) join the next flush.
+- flush_fn runs on one daemon thread, never concurrently with itself.
+- close() drains: everything marked before close is flushed before the
+  thread exits (an orderly shutdown loses nothing).
+- With max_window_s set the window ADAPTS: when a flush takes longer
+  than the floor window (sustained load), the next window stretches to
+  the flush duration so batches grow instead of flush count.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .debug import log
+
+
+class Debouncer:
+    def __init__(
+        self,
+        flush_fn: Callable[[Dict], None],
+        window_s: float = 0.002,
+        max_window_s: Optional[float] = None,
+        merge: Optional[Callable] = None,
+        name: str = "debounce",
+    ) -> None:
+        self._fn = flush_fn
+        self._window = window_s
+        self._max_window = max_window_s
+        self._merge = merge
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._keys: Dict = {}
+        self._flushing = False
+        self._closed = False
+        self._name = name
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=name
+        )
+        self._thread.start()
+
+    def mark(self, key, value=None) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            if self._merge is not None and key in self._keys:
+                value = self._merge(self._keys[key], value)
+            self._keys[key] = value
+            self._cv.notify()
+
+    def flush_now(self, timeout: float = 5.0) -> None:
+        """Block until everything currently marked has FINISHED
+        flushing (not merely been picked up by the flusher)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._keys or self._flushing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                self._cv.wait(remaining)
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop accepting marks and drain: pending keys are flushed
+        before the flusher thread exits."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        last_flush = 0.0
+        while True:
+            with self._cv:
+                while not self._keys and not self._closed:
+                    self._cv.wait()
+                    last_flush = 0.0  # quiet period: back to low latency
+                if self._closed and not self._keys:
+                    return
+                closing = self._closed
+            if not closing:  # closing: drain immediately, no window
+                window = self._window
+                if self._max_window is not None:
+                    window = max(
+                        window, min(last_flush, self._max_window)
+                    )
+                if window > 0:
+                    time.sleep(window)
+            with self._cv:
+                batch = self._keys
+                self._keys = {}
+                self._flushing = True
+            t0 = time.perf_counter()
+            try:
+                self._fn(batch)
+            except Exception as e:  # pragma: no cover - defensive
+                log("debounce", f"{self._name} flush failed: {e}")
+            finally:
+                last_flush = time.perf_counter() - t0
+                with self._cv:
+                    self._flushing = False
+                    self._cv.notify_all()

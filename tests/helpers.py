@@ -51,6 +51,22 @@ def plainify(v):
     return v
 
 
+def wait_until(fn, timeout=10.0, interval=0.005):
+    """Poll until fn() is truthy (live replication tails are batched
+    and asynchronous — net/replication.py flush windows), returning the
+    value; raise on timeout."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        v = fn()
+        if v:
+            return v
+        if time.monotonic() > deadline:
+            raise AssertionError(f"wait_until timed out: {fn}")
+        time.sleep(interval)
+
+
 def sync(*sites):
     for a in sites:
         for b in sites:

@@ -25,7 +25,7 @@ from hypermerge_tpu.storage.colcache import (
 )
 from hypermerge_tpu.utils.ids import validate_doc_url
 
-from helpers import Site, plainify, random_mutation, sync
+from helpers import Site, plainify, random_mutation, sync, wait_until
 
 INF = float("inf")
 
@@ -314,7 +314,8 @@ def test_bulk_loaded_doc_applies_replicated_changes():
             repo2.back.id, doc_id, {doc_id: head + 1}
         )
         actor.feed._append_raw(blockmod.pack(change.to_json()))
-        assert doc.opset is not None  # sync forced the reconstruction
+        # replicated-append syncs are debounced: wait for application
+        wait_until(lambda: doc.opset is not None)
         assert doc.clock[doc_id] == head + 1
         assert repo2.doc(url)["x"] == 99
         repo2.close()

@@ -25,6 +25,77 @@ from hypermerge_tpu.storage.feed import FeedStore, memory_storage_fn
 from hypermerge_tpu.utils.ids import url_to_id
 
 
+class TestRemoteFileFetch:
+    """Hyperfile replication end-to-end (VERDICT r5 item 5): a repo
+    fetches a file it doesn't hold from a peer over encrypted TCP,
+    streaming blocks with progress events (reference
+    src/FileStore.ts:33-36 + src/ReplicationManager.ts:71-89)."""
+
+    def _tcp_pair(self):
+        from hypermerge_tpu.net.tcp import TcpSwarm
+
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        return ra, rb, sa, sb
+
+    def test_one_mib_file_replicates_over_tcp_with_progress(self):
+        ra, rb, sa, sb = self._tcp_pair()
+        try:
+            data = os.urandom(1024 * 1024)
+            header = ra.back.get_file_store().write(
+                data, "application/octet-stream"
+            )
+            file_id = url_to_id(header.url)
+            fs_b = rb.back.get_file_store()
+            progress = []
+            fs_b.subscribe_progress(
+                file_id, lambda blocks, nbytes: progress.append(
+                    (blocks, nbytes)
+                )
+            )
+            got = fs_b.read_bytes(file_id, timeout=60)
+            assert got == data
+            hdr = fs_b.header_wait(file_id, timeout=10)
+            assert hdr.sha256 == header.sha256
+            assert hdr.size == len(data)
+            assert hdr.mime_type == "application/octet-stream"
+            assert hdr.blocks == 17  # 1MiB @ 62KiB
+            # progress fired per block: 17 data + 1 header
+            assert progress and progress[-1][0] == 18
+            assert progress[-1][1] >= len(data)
+        finally:
+            ra.close()
+            rb.close()
+            sa.destroy()
+            sb.destroy()
+
+    def test_remote_read_times_out_when_no_holder(self):
+        from hypermerge_tpu.utils import keys as keymod
+        from hypermerge_tpu.utils.ids import to_hyperfile_url
+
+        repo = Repo(memory=True)
+        try:
+            bogus = keymod.create().public_key
+            fs = repo.back.get_file_store()
+            with pytest.raises(TimeoutError):
+                fs.read_bytes(url_to_id(to_hyperfile_url(bogus)),
+                              timeout=0.3)
+        finally:
+            repo.close()
+
+    def test_local_read_semantics_unchanged(self):
+        """timeout=0 keeps the strict local contract: missing feeds
+        raise FileNotFoundError immediately."""
+        store = FileStore(FeedStore(memory_storage_fn))
+        from hypermerge_tpu.utils import keys as keymod
+
+        with pytest.raises(FileNotFoundError):
+            store.read_bytes(keymod.create().public_key)
+
+
 def server_path() -> str:
     return os.path.join(
         tempfile.gettempdir(), f"hypermerge-tpu-test-{uuid.uuid4().hex[:8]}.sock"

@@ -19,6 +19,8 @@ from hypermerge_tpu.storage.integrity import Peaks, signable
 from hypermerge_tpu.utils import crypto
 from hypermerge_tpu.utils import keys as keymod
 
+from helpers import wait_until
+
 
 class TestMerklePeaks:
     def test_incremental_root_matches_bulk(self):
@@ -60,8 +62,34 @@ class TestWriterSigning:
         f = feeds.create(keymod.create())
         for i in range(5):
             f.append(f"block{i}".encode())
-        assert f.integrity.signed_length == 5
+        # live appends sign lazily; audit seals the head first
         assert f.audit()
+        assert f.integrity.signed_length == 5
+
+    def test_lazy_signing_seals_on_close(self, tmp_path):
+        """Appends below the sign interval leave no per-append records;
+        close() persists one covering the head, and a fresh process
+        audits clean (the crash-recovery contract of lazy signing)."""
+        from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
+        from hypermerge_tpu.storage.integrity import file_sig_storage_fn
+
+        root = str(tmp_path)
+        feeds = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        pair = keymod.create()
+        f = feeds.create(pair)
+        for i in range(5):
+            f.append(f"block{i}".encode())
+        assert f.integrity.unsigned_tail
+        feeds.close()
+        feeds2 = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        f2 = feeds2.create(pair)
+        assert f2.integrity.signed_length == 5
+        assert f2.audit()
+        feeds2.close()
 
     def test_on_disk_block_tamper_detected(self, tmp_path):
         repo = Repo(path=str(tmp_path))
@@ -122,10 +150,60 @@ class TestWriterSigning:
         repo2.close()
 
 
+class TestLazySigningAudit:
+    def _file_feeds(self, root):
+        from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
+        from hypermerge_tpu.storage.integrity import file_sig_storage_fn
+
+        return FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+
+    def test_foreign_tail_block_fails_audit_not_laundered(self, tmp_path):
+        """A block appended to the on-disk log beyond the signed chain
+        (crash leftovers or attacker) must FAIL the audit on reopen —
+        never be sealed into validity by the writer's own key."""
+        import struct
+
+        root = str(tmp_path)
+        feeds = self._file_feeds(root)
+        pair = keymod.create()
+        f = feeds.create(pair)
+        for i in range(3):
+            f.append(b"block%d" % i)
+        feeds.close()  # seals at length 3
+
+        log_path = os.path.join(
+            root, pair.public_key[:2], pair.public_key
+        )
+        forged = b"forged!"
+        with open(log_path, "ab") as fh:
+            fh.write(struct.pack("<I", len(forged)) + forged)
+        # .len sidecar now mismatches -> storage rescans and sees 4
+        os.remove(log_path + ".len")
+
+        feeds2 = self._file_feeds(root)
+        f2 = feeds2.create(pair)  # writable: the dangerous case
+        assert f2.length == 4
+        assert f2.audit() is False, "foreign tail must not be sealed"
+        # and the chain on disk still stops at 3
+        assert f2.integrity.signed_length == 3
+        feeds2.close()
+
+    def test_in_process_tail_still_audits_clean(self):
+        feeds = FeedStore(memory_storage_fn)
+        f = feeds.create(keymod.create())
+        f.append(b"one")
+        f.append(b"two")
+        assert f.audit()  # in-process unsigned tail: sealed + verified
+
+
 class TestSignChain:
     def test_sign_chain_matches_live_writer_records(self, tmp_path):
-        """integrity.sign_chain (corpus writer) == sign_append's stored
-        records, byte for byte."""
+        """integrity.sign_chain (dense corpus format) and the live
+        writer agree on every boundary: a sealed live feed's head record
+        equals sign_chain's last record byte-for-byte, and record_for
+        reproduces ANY intermediate record of the dense chain."""
         from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
         from hypermerge_tpu.storage.integrity import (
             _REC,
@@ -142,12 +220,19 @@ class TestSignChain:
         blocks = [f"block{i}".encode() for i in range(7)]
         for b in blocks:
             f.append(b)
+        f.seal()
         sig_path = os.path.join(
             root, pair.public_key[:2], pair.public_key + ".sig"
         )
         on_disk = open(sig_path, "rb").read()
-        assert on_disk == sign_chain(blocks, keymod.decode(pair.secret_key))
-        assert len(on_disk) == 7 * _REC.size
+        dense = sign_chain(blocks, keymod.decode(pair.secret_key))
+        assert on_disk == dense[-_REC.size:]  # head record identical
+        # every intermediate boundary the dense chain stores is
+        # reproducible on demand by the live writer
+        for i in range(7):
+            want = _REC.unpack_from(dense, i * _REC.size)
+            got = f.integrity.record_for(f, i + 1)
+            assert got == want, i
 
 
 class TestReplicationVerification:
@@ -163,8 +248,9 @@ class TestReplicationVerification:
         assert fb.read_all() == fa.read_all()
         # the replica stored verified records it can audit and re-serve
         assert fb.audit()
-        # live tail stays verified
+        # live tail stays verified (batched flush: asynchronous)
         fa.append(b"live")
+        wait_until(lambda: fb.length == 6)
         assert fb.read_all()[-1] == b"live"
         assert fb.audit()
 
@@ -467,6 +553,7 @@ class TestTamperFuzz:
         blocks = [rng.randbytes(rng.randint(10, 80)) for _ in range(6)]
         for b in blocks:
             fa.append(b)
+        fa.seal()  # lazy signing: pin a head record to tamper against
         rec = fa.integrity.latest()
 
         for trial in range(24):
@@ -508,7 +595,7 @@ class TestProgressEvents:
         h.subscribe_progress(lambda *a: events.append(a))
         for i in range(5):
             ra.change(url, lambda d: d.__setitem__("n", i))
-        assert rb.doc(url)["n"] == 4
+        wait_until(lambda: rb.doc(url).get("n") == 4)
         assert events, "no Download progress events during sync"
         ra.close()
         rb.close()

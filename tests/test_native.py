@@ -108,9 +108,19 @@ def test_block_codec_rejects_corrupt_blocks_with_valueerror():
 
 def test_block_codec_forced_zlib(monkeypatch):
     monkeypatch.setenv("HM_BLOCK_CODEC", "zlib")
-    obj = {"k": "v" * 200}
+    obj = {"k": "v" * 600}  # above the small-block raw threshold
     data = blockmod.pack(obj)
     assert data[:2] == b"ZL"
+    assert blockmod.unpack(data) == obj
+
+
+def test_tiny_blocks_stored_raw():
+    """Blocks under the compression threshold store as raw JSON —
+    framing+cpu beats the handful of saved bytes on interactive
+    single-op changes."""
+    obj = {"k": "v"}
+    data = blockmod.pack(obj)
+    assert data[:1] in (b"{", b"[")
     assert blockmod.unpack(data) == obj
 
 
@@ -138,9 +148,9 @@ assert pair.public_key  # pure-python ed25519
 sig = crypto.sign(b"m", bytes(32))
 assert crypto.verify(b"m", sig, keys.decode(pair.public_key))
 from hypermerge_tpu.storage import block
-data = block.pack({"a": "b" * 100})
+data = block.pack({"a": "b" * 600})
 assert data[:2] == b"ZL"  # brotli unavailable -> zlib
-assert block.unpack(data) == {"a": "b" * 100}
+assert block.unpack(data) == {"a": "b" * 600}
 print("OK")
 """
     env = dict(os.environ, HM_NO_NATIVE="1")
@@ -166,7 +176,7 @@ def test_feed_blocks_use_brotli_end_to_end(tmp_path):
 
     path = str(tmp_path / "repo")
     repo = Repo(path=path)
-    url = repo.create({"text": "hello " * 50})
+    url = repo.create({"text": "hello " * 200})
     repo.change(url, lambda d: d.__setitem__("n", 1))
     want = plainify(repo.doc(url))
     doc_id = validate_doc_url(url)

@@ -224,3 +224,77 @@ def test_ed25519_rejects_noncanonical_encoding():
         bad_sig = bad.to_bytes(32, "little") + sig[32:]
         assert not ed25519.verify(b"m", bad_sig, pub)
     assert ed25519.verify(b"m", sig, pub)
+
+
+class TestDebouncer:
+    def test_coalesces_and_flushes(self):
+        import time as _t
+
+        from hypermerge_tpu.utils.debounce import Debouncer
+
+        batches = []
+        d = Debouncer(batches.append, window_s=0.01)
+        for i in range(50):
+            d.mark("k", i)
+        d.flush_now()
+        assert batches and len(batches) <= 3
+        assert batches[0]["k"] == 49  # default merge: latest wins
+        d.close()
+
+    def test_merge_fn(self):
+        from hypermerge_tpu.utils.debounce import Debouncer
+
+        batches = []
+        d = Debouncer(batches.append, window_s=0.01, merge=min)
+        d.mark("k", 7)
+        d.mark("k", 3)
+        d.mark("k", 9)
+        d.flush_now()
+        assert batches[0]["k"] == 3
+        d.close()
+
+    def test_close_drains_pending(self):
+        """Marks made before close() still flush — orderly shutdown
+        loses nothing (the replication tail relies on this)."""
+        from hypermerge_tpu.utils.debounce import Debouncer
+
+        batches = []
+        d = Debouncer(batches.append, window_s=5.0)  # huge window
+        d.mark("a", 1)
+        d.mark("b", 2)
+        d.close()  # must not wait the 5s window
+        assert {"a": 1, "b": 2} in batches
+
+    def test_flush_now_waits_for_inflight_flush(self):
+        """flush_now returns only after flush_fn FINISHED, not merely
+        after the pending set was swapped out."""
+        import threading as _th
+
+        from hypermerge_tpu.utils.debounce import Debouncer
+
+        started = _th.Event()
+        release = _th.Event()
+        done = []
+
+        def slow_flush(batch):
+            started.set()
+            release.wait(5)
+            done.append(batch)
+
+        d = Debouncer(slow_flush, window_s=0.0)
+        d.mark("k")
+        assert started.wait(5)
+        waiter_done = _th.Event()
+
+        def waiter():
+            d.flush_now(timeout=5)
+            waiter_done.set()
+
+        t = _th.Thread(target=waiter)
+        t.start()
+        assert not waiter_done.wait(0.1), "returned during in-flight flush"
+        release.set()
+        assert waiter_done.wait(5)
+        assert done
+        t.join(5)
+        d.close()
