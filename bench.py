@@ -186,6 +186,44 @@ def _config5_union(n_docs=100_000, n_actors=64, seed=0, dirty=1000):
     return dt * 1e3  # ms
 
 
+def _config6_text_trace(n_ops=259_778):
+    """automerge-perf trace shape (BASELINE.md): ONE text doc, ONE
+    author, one op per change — 259,778 ops, the published workload the
+    reference's engine (automerge 0.14) takes MINUTES on (~0.4-0.9k
+    ops/s, multi-GB heap). Timed region: a warm device materialize of
+    the full trace + char-joined text extraction to a host string.
+    Correctness at this scale is pinned by tests/test_text_scale.py
+    (device == numpy twin == OpSet)."""
+    import numpy as np
+
+    from hypermerge_tpu.crdt.change import Action
+    from hypermerge_tpu.ops.materialize import (
+        materialize_batch,
+        text_join,
+    )
+    from hypermerge_tpu.ops.synth import synth_changes
+
+    changes = synth_changes(
+        n_ops, n_actors=1, ops_per_change=1, text_frac=1.0, seed=3
+    )
+
+    def full_pass():
+        dec = materialize_batch([changes])
+        n = int(dec.batch.n_ops[0])
+        rows = np.nonzero(
+            dec.cols["action"][0][:n] == int(Action.MAKE_TEXT)
+        )[0]
+        return text_join(dec, 0, int(rows[0]))
+
+    full_pass()  # compile + warm every program in the 256k bucket
+
+    t0 = time.perf_counter()
+    text = full_pass()
+    dt = time.perf_counter() - t0
+    assert len(text) > 1000, len(text)
+    return dt, n_ops / dt
+
+
 def main() -> None:
     n_docs = int(os.environ.get("BENCH_DOCS", "10240"))
     n_ops = int(os.environ.get("BENCH_OPS", "1024"))
@@ -343,6 +381,14 @@ def main() -> None:
             f"dirty): {cfg5:.1f}ms",
             file=sys.stderr,
         )
+    cfg6 = _soft("config6", _config6_text_trace)
+    if cfg6 is not None:
+        print(
+            f"# config6 automerge-perf text trace (259,778 ops, 1 doc): "
+            f"{cfg6[0]:.2f}s -> {cfg6[1]:,.0f} ops/s "
+            f"(reference engine: ~0.4-0.9k ops/s)",
+            file=sys.stderr,
+        )
 
     if not bench_dir:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -365,6 +411,9 @@ def main() -> None:
                     ),
                     "config5_union_100k_ms": (
                         round(cfg5, 1) if cfg5 is not None else None
+                    ),
+                    "config6_text_trace_ops_per_s": (
+                        round(cfg6[1]) if cfg6 is not None else None
                     ),
                     "docs": n_docs,
                     "ops_per_doc": n_ops,

@@ -439,7 +439,12 @@ def text_join(dec: DecodedBatch, d: int, text_obj_row: int) -> str:
     rows = np.nonzero(mask)[0]
     rows = rows[np.argsort(-dec.rank[d][rows], kind="stable")]
     strings = dec.batch.strings
+    # pull the selected columns to host ONCE — per-element indexing of
+    # a (possibly device-resident) array is a scalar transfer each on
+    # the TPU tunnel, which at automerge-perf scale (260k chars) costs
+    # more than the whole kernel
+    vals = np.asarray(c["value"][d])[rows].tolist()
+    kinds = np.asarray(c["vkind"][d])[rows].tolist()
     return "".join(
-        strings[c["value"][d][r]] if c["vkind"][d][r] == 3 else ""
-        for r in rows
+        strings[v] if k == 3 else "" for v, k in zip(vals, kinds)
     )

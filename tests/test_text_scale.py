@@ -1,0 +1,73 @@
+"""Text at automerge-perf scale (VERDICT r5 item 6).
+
+The reference's CRDT engine (automerge 0.14, Immutable.js) is publicly
+documented to take minutes on the 259,778-op automerge-perf LaTeX
+editing trace (BASELINE.md: ~0.4-0.9k ops/s, multi-GB heap). That shape
+— ONE text doc, ONE author, one op per change — must go through this
+framework's device kernel (and its numpy host twin) at speed, in the
+N=128k+ jit bucket no small-doc test ever touches.
+
+Correctness at scale is pinned two ways:
+- device kernel == host numpy twin, field-for-field, at 128k ops (the
+  twin is itself fuzz-equivalent to OpSet — test_device_materialize);
+- device text == host OpSet text, char-for-char, at 8k ops (OpSet
+  replay is too slow above that — which is the point of the kernel).
+"""
+
+import numpy as np
+
+from hypermerge_tpu.crdt.opset import OpSet
+from hypermerge_tpu.models import Text
+from hypermerge_tpu.ops.columnar import pack_docs
+from hypermerge_tpu.ops.materialize import (
+    materialize_batch,
+    text_join,
+)
+from hypermerge_tpu.ops.synth import synth_changes
+
+
+def _trace_shaped(n_ops: int, seed: int = 3):
+    """automerge-perf trace shape: one author, one op per change, all
+    text edits."""
+    return synth_changes(
+        n_ops, n_actors=1, ops_per_change=1, text_frac=1.0, seed=seed
+    )
+
+
+def _device_text(dec, d: int = 0) -> str:
+    c = dec.cols
+    from hypermerge_tpu.crdt.change import Action
+
+    n = int(dec.batch.n_ops[d])
+    text_rows = np.nonzero(
+        c["action"][d][:n] == int(Action.MAKE_TEXT)
+    )[0]
+    assert len(text_rows) == 1, len(text_rows)
+    return text_join(dec, d, int(text_rows[0]))
+
+
+def test_text_128k_device_matches_host_twin():
+    from hypermerge_tpu.ops.crdt_kernels import run_batch
+    from hypermerge_tpu.ops.host_kernel import run_batch_host
+
+    changes = _trace_shaped(131_072)
+    batch = pack_docs([changes])
+    dev = run_batch(batch)
+    host = run_batch_host(batch)
+    for f in host._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dev, f)), getattr(host, f), err_msg=f
+        )
+
+
+def test_text_8k_device_matches_opset_charwise():
+    changes = _trace_shaped(8_192)
+    opset = OpSet()
+    opset.apply_changes(changes)
+    doc = opset.materialize()
+    want = str(doc["t"])
+    assert isinstance(doc["t"], Text) and len(want) > 100
+
+    dec = materialize_batch([changes])
+    assert _device_text(dec) == want
+    assert dec.clock_dict(0) == opset.clock
