@@ -806,6 +806,11 @@ class RepoBackend:
                     doc.id, event["patch"].to_json(), doc.history_len
                 )
             )
+            # our applied clock advanced: re-gossip so peers BEYOND the
+            # source learn it too (relay re-serving — a passive middle
+            # repo must propagate actor knowledge, reference
+            # src/RepoBackend.ts:394-427). Monotone, so it terminates.
+            self._gossip_cursor(doc)
         elif t == "ActorId":
             self.to_frontend.push(
                 msgs.actor_id_msg(doc.id, event["actorId"])
@@ -911,7 +916,8 @@ class RepoBackend:
         recorded under the SENDER's id — our own clock row only ever
         reflects changes we actually applied (else we'd advertise state we
         can't supply to third parties)."""
-        self.cursors.update(self.id, doc_id, cursors)
+        before = self.cursors.get(self.id, doc_id)
+        after = self.cursors.update(self.id, doc_id, cursors)
         self.clocks.update(peer.id, doc_id, clocks)
         doc = self.docs.get(doc_id)
         if doc is not None:
@@ -919,6 +925,10 @@ class RepoBackend:
         for actor_id in cursors:
             actor = self._get_or_create_actor(actor_id)
             self._sync_changes(actor)
+        if after != before:
+            # our cursor EXPANDED from remote knowledge: relay it to
+            # the other peers (strictly monotone — no gossip loop)
+            self._gossip.mark(doc_id)
 
     def on_discovery(self, public_id: str, peer) -> None:
         """A feed shared with `peer` was discovered: send our cursor +
@@ -981,12 +991,12 @@ class RepoBackend:
         self._file_server.listen(path)
         self.to_frontend.push(msgs.file_server_ready_msg(path))
 
-    def set_swarm(self, swarm) -> None:
+    def set_swarm(self, swarm, join_options=None) -> None:
         from ..net.network import Network  # local import: net dep optional
 
         if self.network is None:
             self.network = Network(self)
-        self.network.set_swarm(swarm)
+        self.network.set_swarm(swarm, join_options)
 
     # ------------------------------------------------------------------
 

@@ -36,10 +36,14 @@ def serve_backend(
     repo_path: Optional[str] = None,
     memory: bool = False,
     once: bool = True,
+    tcp_listen: bool = False,
+    tcp_connect: Optional[list] = None,
 ) -> None:
     """Host a RepoBackend behind a unix socket. `once` serves a single
     frontend connection then returns (the reference pairs exactly one
-    frontend per backend)."""
+    frontend per backend). With `tcp_listen`/`tcp_connect` the backend
+    process also joins the peer swarm over TCP (the daemon owns the
+    networking; the frontend process needs none of it loaded)."""
     from ..backend.repo_backend import RepoBackend
 
     if os.path.exists(sock_path):
@@ -48,14 +52,32 @@ def serve_backend(
     server.bind(sock_path)
     server.listen(1)
     print(f"backend ready on {sock_path}", flush=True)
+
+    def build_backend() -> "RepoBackend":
+        # the daemon's repo + swarm come up BEFORE a frontend attaches:
+        # it replicates with peers on its own; the frontend is a client
+        back = RepoBackend(path=repo_path, memory=memory)
+        if tcp_listen or tcp_connect:
+            from .tcp import TcpSwarm
+
+            swarm = TcpSwarm()
+            back.set_swarm(swarm)
+            host, port = swarm.address
+            print(f"swarm listening on {host}:{port}", flush=True)
+            for addr in tcp_connect or []:
+                h, _, p = addr.rpartition(":")
+                swarm.connect((h, int(p)))
+        return back
+
+    back = build_backend()
     while True:
         conn, _ = server.accept()
         duplex = TcpDuplex(conn, is_client=False)
         if duplex.closed:
-            # failed handshake (probe, misconfigured client): this was
-            # not the frontend — keep the serve slot open
+            # failed handshake (probe, health check, misconfigured
+            # client): this was not the frontend — the LIVE backend,
+            # its swarm, and its replicated state stay untouched
             continue
-        back = RepoBackend(path=repo_path, memory=memory)
         back.subscribe(duplex.send)
         duplex.on_message(back.receive)
         gone = threading.Event()
@@ -66,6 +88,7 @@ def serve_backend(
             server.close()
             os.remove(sock_path)
             return
+        back = build_backend()
 
 
 def connect_frontend(
@@ -89,20 +112,30 @@ def connect_frontend(
 
 
 def main() -> None:
-    import sys
+    import argparse
 
-    if len(sys.argv) < 3:
-        print(
-            "usage: python -m hypermerge_tpu.net.ipc "
-            "(<repo-path>|:memory:) <socket-path>",
-            file=sys.stderr,
-        )
-        raise SystemExit(2)
-    repo_path, sock_path = sys.argv[1], sys.argv[2]
-    if repo_path == ":memory:":
-        serve_backend(sock_path, memory=True)
-    else:
-        serve_backend(sock_path, repo_path=repo_path)
+    ap = argparse.ArgumentParser(
+        prog="python -m hypermerge_tpu.net.ipc",
+        description="Host a RepoBackend daemon behind a unix socket.",
+    )
+    ap.add_argument("repo_path", help="repo directory, or :memory:")
+    ap.add_argument("sock_path", help="unix socket for the frontend")
+    ap.add_argument(
+        "--listen", action="store_true",
+        help="join the peer swarm: listen on TCP (address printed)",
+    )
+    ap.add_argument(
+        "--connect", action="append", default=[], metavar="HOST:PORT",
+        help="join the peer swarm: dial another backend (repeatable)",
+    )
+    args = ap.parse_args()
+    serve_backend(
+        args.sock_path,
+        repo_path=None if args.repo_path == ":memory:" else args.repo_path,
+        memory=args.repo_path == ":memory:",
+        tcp_listen=args.listen,
+        tcp_connect=args.connect,
+    )
 
 
 if __name__ == "__main__":

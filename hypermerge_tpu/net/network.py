@@ -20,7 +20,7 @@ from .connection import PeerConnection
 from .duplex import Duplex
 from .peer import NetworkPeer
 from .replication import ReplicationManager
-from .swarm import ConnectionDetails, Swarm
+from .swarm import DEFAULT_JOIN, ConnectionDetails, JoinOptions, Swarm
 
 MSGS_CHANNEL = "Msgs"
 
@@ -30,6 +30,7 @@ class Network:
         self.backend = backend
         self.self_id: str = backend.id
         self.swarm: Optional[Swarm] = None
+        self.join_options: JoinOptions = DEFAULT_JOIN
         self.joined: Set[str] = set()
         self.pending_joins: Set[str] = set()
         self.peers: Dict[str, NetworkPeer] = {}
@@ -42,10 +43,15 @@ class Network:
     # ------------------------------------------------------------------
     # swarm lifecycle
 
-    def set_swarm(self, swarm: Swarm) -> None:
+    def set_swarm(
+        self, swarm: Swarm, join_options: Optional[JoinOptions] = None
+    ) -> None:
         if self.swarm is not None:
             raise RuntimeError("swarm already set")
         self.swarm = swarm
+        # the repo's swarm posture (reference Network.ts:22 — every
+        # join uses it; server-ish repos announce, clients look up)
+        self.join_options = join_options or DEFAULT_JOIN
         # authenticated transport: hand the repo's static ed25519 seed to
         # the swarm so every connection's handshake signs the ephemeral
         # transcript (net/secure.py auth; reference noise-peer static
@@ -68,7 +74,7 @@ class Network:
             if discovery_id in self.joined:
                 return
             self.joined.add(discovery_id)
-        self.swarm.join(discovery_id)
+        self.swarm.join(discovery_id, self.join_options)
 
     def leave(self, discovery_id: str) -> None:
         with self._lock:

@@ -11,9 +11,25 @@ socket-based swarm for real inter-process networking.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional, Set
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .duplex import Duplex, duplex_pair
+
+
+@dataclass(frozen=True)
+class JoinOptions:
+    """Discovery asymmetry (reference src/SwarmInterface.ts:22-25 +
+    Network.ts:22 — the repo's swarm posture): `announce` makes a
+    joined id discoverable by peers looking it up; `lookup` actively
+    seeks announcers. Server-ish peers announce, clients look up;
+    default is both."""
+
+    announce: bool = True
+    lookup: bool = True
+
+
+DEFAULT_JOIN = JoinOptions()
 
 
 class ConnectionDetails:
@@ -38,7 +54,9 @@ class Swarm:
         (net/tcp.py). Default: ignored — in-process loopback pairs have
         no wire to protect."""
 
-    def join(self, discovery_id: str) -> None:
+    def join(
+        self, discovery_id: str, options: JoinOptions = DEFAULT_JOIN
+    ) -> None:
         raise NotImplementedError
 
     def leave(self, discovery_id: str) -> None:
@@ -54,28 +72,43 @@ class Swarm:
 
 
 class LoopbackHub:
-    """Shared rendezvous for LoopbackSwarms in one process: when two
-    swarms join the same discovery id, a duplex pair connects them."""
+    """Shared rendezvous for LoopbackSwarms in one process: when one
+    swarm LOOKS UP a discovery id another swarm ANNOUNCES, a duplex
+    pair connects them (the looker-up is the client). Two lookup-only
+    members never pair — a lookup-only join is invisible to inbound
+    discovery (reference JoinOptions asymmetry)."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._members: Dict[str, List["LoopbackSwarm"]] = {}
+        self._members: Dict[
+            str, List[Tuple["LoopbackSwarm", JoinOptions]]
+        ] = {}
 
-    def join(self, swarm: "LoopbackSwarm", discovery_id: str) -> None:
+    def join(
+        self,
+        swarm: "LoopbackSwarm",
+        discovery_id: str,
+        options: JoinOptions = DEFAULT_JOIN,
+    ) -> None:
         with self._lock:
             members = self._members.setdefault(discovery_id, [])
-            others = [s for s in members if s is not swarm]
-            if swarm not in members:
-                members.append(swarm)
-        for other in others:
-            if (other, swarm) not in _connected_pairs(swarm, other):
-                _connect(swarm, other)
+            members[:] = [(s, o) for s, o in members if s is not swarm]
+            members.append((swarm, options))
+            others = [(s, o) for s, o in members if s is not swarm]
+        for other, other_opts in others:
+            if options.lookup and other_opts.announce:
+                client, server = swarm, other
+            elif options.announce and other_opts.lookup:
+                client, server = other, swarm
+            else:
+                continue  # lookup/lookup or announce/announce: no pair
+            if (client, server) not in _connected_pairs(client, server):
+                _connect(client, server)
 
     def leave(self, swarm: "LoopbackSwarm", discovery_id: str) -> None:
         with self._lock:
             members = self._members.get(discovery_id, [])
-            if swarm in members:
-                members.remove(swarm)
+            members[:] = [(s, o) for s, o in members if s is not swarm]
 
 
 def _connected_pairs(a: "LoopbackSwarm", b: "LoopbackSwarm") -> Set:
@@ -99,9 +132,11 @@ class LoopbackSwarm(Swarm):
         self.connected: Set = set()
         self._cb: Optional[Callable] = None
 
-    def join(self, discovery_id: str) -> None:
+    def join(
+        self, discovery_id: str, options: JoinOptions = DEFAULT_JOIN
+    ) -> None:
         self.joined.add(discovery_id)
-        self.hub.join(self, discovery_id)
+        self.hub.join(self, discovery_id, options)
 
     def leave(self, discovery_id: str) -> None:
         self.joined.discard(discovery_id)

@@ -236,6 +236,7 @@ class TcpSwarm(Swarm):
         self._server.bind((host, port))
         self._server.listen(16)
         self.address: Tuple[str, int] = self._server.getsockname()
+        self.join_options: dict = {}
         self._cb: Optional[Callable] = None
         self._duplexes: List[TcpDuplex] = []
         self._destroyed = False
@@ -290,11 +291,17 @@ class TcpSwarm(Swarm):
             self._cb(duplex, ConnectionDetails(client=True))
 
     # discovery is external (reference: hyperswarm); topics are no-ops here
-    def join(self, discovery_id: str) -> None:
-        pass
+    def join(self, discovery_id: str, options=None) -> None:
+        # topology is explicit (connect()); per-id discovery — and so
+        # the announce/lookup asymmetry — doesn't apply, matching
+        # hyperswarm-with-direct-connections semantics. Options are
+        # recorded for introspection.
+        from .swarm import DEFAULT_JOIN
+
+        self.join_options[discovery_id] = options or DEFAULT_JOIN
 
     def leave(self, discovery_id: str) -> None:
-        pass
+        self.join_options.pop(discovery_id, None)
 
     def on_connection(self, cb) -> None:
         self._cb = cb

@@ -94,8 +94,11 @@ class Repo:
     def files(self):
         return self.front.files
 
-    def set_swarm(self, swarm) -> None:
-        self.back.set_swarm(swarm)
+    def set_swarm(self, swarm, join_options=None) -> None:
+        """Attach a peer swarm. `join_options` sets the repo's swarm
+        posture (net/swarm.JoinOptions — announce and/or lookup;
+        reference src/Repo.ts:20 setSwarm(swarm, joinOptions))."""
+        self.back.set_swarm(swarm, join_options)
 
     def start_file_server(self, path: str) -> None:
         self.back.start_file_server(path)

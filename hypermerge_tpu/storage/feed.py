@@ -211,6 +211,12 @@ class Feed:
         # signed-merkle state (storage/integrity.py), attached by
         # FeedStore; loaded lazily (bulk cold opens never read it)
         self.integrity = None
+        # sparse side-buffer: inclusion-proof-verified blocks fetched
+        # OUT OF ORDER (net/replication.py range fetch — hypercore's
+        # sparse download). The contiguous log stays authoritative;
+        # entries are dropped as the head passes them.
+        self._sparse: Dict[int, bytes] = {}
+        self._sparse_listeners: List[Callable[[int, bytes], None]] = []
 
     @property
     def writable(self) -> bool:
@@ -238,6 +244,7 @@ class Feed:
             index = len(self._storage) - 1
             if self.integrity is not None:
                 self.integrity.sign_append(self, index, data)
+            self._prune_sparse_locked()
             listeners = list(self._append_listeners)
             extended = list(self._extend_listeners)
         for cb in listeners:
@@ -276,6 +283,7 @@ class Feed:
                 self._storage.append(b)
                 indices.append(len(self._storage) - 1)
             self.integrity.record_verified(length, root, sig, new_leaves)
+            self._prune_sparse_locked()
             listeners = list(self._append_listeners)
             extended = list(self._extend_listeners)
         for i, b in zip(indices, eff):
@@ -315,6 +323,7 @@ class Feed:
         with self._lock:
             self._storage.append(data)
             index = len(self._storage) - 1
+            self._prune_sparse_locked()
             listeners = list(self._append_listeners)
             extended = list(self._extend_listeners)
         for cb in listeners:
@@ -322,6 +331,44 @@ class Feed:
         for cb in extended:
             cb(index, index + 1)
         return index
+
+    def put_sparse(self, index: int, data: bytes) -> None:
+        """Store an out-of-order block the caller has ALREADY verified
+        (inclusion proof against a signed root — net/replication.py)."""
+        with self._lock:
+            if index < len(self._storage):
+                return  # contiguous log already holds it
+            self._sparse[index] = data
+            listeners = list(self._sparse_listeners)
+        for cb in listeners:
+            cb(index, data)
+
+    def _prune_sparse_locked(self) -> None:
+        # caller holds the lock; entries the contiguous head passed are
+        # redundant (storage is authoritative for them)
+        if self._sparse:
+            head = len(self._storage)
+            for i in [i for i in self._sparse if i < head]:
+                del self._sparse[i]
+
+    def get_sparse(self, index: int) -> Optional[bytes]:
+        """Block at `index` from the contiguous log or the sparse
+        buffer; None when neither holds it."""
+        with self._lock:
+            if index < len(self._storage):
+                return self._storage.get(index)
+            data = self._sparse.get(index)
+            if data is None:
+                return None
+            return data
+
+    def has_block(self, index: int) -> bool:
+        with self._lock:
+            return index < len(self._storage) or index in self._sparse
+
+    def on_sparse(self, cb: Callable[[int, bytes], None]) -> None:
+        with self._lock:
+            self._sparse_listeners.append(cb)
 
     def get(self, index: int) -> bytes:
         with self._lock:

@@ -343,6 +343,26 @@ class FeedIntegrity:
             self._ensure_records().append((length, root, sig))
             self._store.append(length, root, sig)
 
+    def range_proofs(self, feed, start: int, end: int):
+        """Serve a sparse range: (proof_length, sig, [(block, proof)])
+        for blocks [start, end) against a signed record — a stored one
+        covering the range, else (writable feeds) one signed on demand
+        at the head. None when no record can cover `end`."""
+        rec = None
+        for r in self._ensure_records():
+            if r[0] >= end:
+                rec = r
+                break
+        if rec is None:
+            rec = self.record_for(feed, feed.length)
+            if rec is None or rec[0] < end:
+                return None
+        length, _root, sig = rec
+        leaves = self._ensure_leaves(feed, length)
+        blocks = feed.get_batch(start, end)
+        proofs = range_inclusion_proofs(leaves, start, end, length)
+        return (length, sig, list(zip(blocks, proofs)))
+
     # -- disk audit ---------------------------------------------------------
 
     def destroy(self) -> None:
@@ -387,6 +407,117 @@ class FeedIntegrity:
             if not crypto.verify(signable(length, root), sig, pub):
                 return False
         return True
+
+
+def _peak_sizes(length: int) -> List[int]:
+    """Subtree sizes of the promote-odd forest at `length`: the set
+    bits of length, largest first (binary-counter peaks). Peak j covers
+    leaves [sum(sizes[:j]), sum(sizes[:j+1]))."""
+    sizes = []
+    bit = 1 << (length.bit_length() - 1) if length else 0
+    while bit:
+        if length & bit:
+            sizes.append(bit)
+        bit >>= 1
+    return sizes
+
+
+def _peak_levels(leaves: List[bytes]) -> List[List[bytes]]:
+    """All levels of one perfect subtree, bottom-up (levels[-1][0] is
+    its root)."""
+    levels = [list(leaves)]
+    while len(levels[-1]) > 1:
+        lvl = levels[-1]
+        levels.append(
+            [_parent(lvl[i], lvl[i + 1]) for i in range(0, len(lvl), 2)]
+        )
+    return levels
+
+
+def range_inclusion_proofs(
+    leaves: List[bytes], start: int, end: int, length: int
+) -> List[List[bytes]]:
+    """Merkle inclusion proofs for leaves [start, end) against the
+    promote-odd root at `length` (hypercore's sparse-download
+    verification model: a peer verifies blocks against a signed root
+    without holding the prefix). Each proof = the sibling path inside
+    the leaf's peak subtree (bottom-up), then every OTHER peak root in
+    forest order — positions derive client-side from (index, length),
+    so a proof is just hashes, ≤ 2·log2(length) of them. The tree
+    levels are built ONCE for the whole range: O(length) hashing total,
+    not O(range × length)."""
+    sizes = _peak_sizes(length)
+    offs: List[int] = []
+    levels: List[List[List[bytes]]] = []
+    roots: List[bytes] = []
+    o = 0
+    for s in sizes:
+        lv = _peak_levels(leaves[o : o + s])
+        offs.append(o)
+        levels.append(lv)
+        roots.append(lv[-1][0])
+        o += s
+    out: List[List[bytes]] = []
+    for index in range(start, end):
+        j = 0
+        while index >= offs[j] + sizes[j]:
+            j += 1
+        proof: List[bytes] = []
+        p = index - offs[j]
+        for lvl in levels[j][:-1]:
+            proof.append(lvl[p ^ 1])
+            p >>= 1
+        proof.extend(roots[q] for q in range(len(sizes)) if q != j)
+        out.append(proof)
+    return out
+
+
+def inclusion_proof(
+    leaves: List[bytes], index: int, length: int
+) -> List[bytes]:
+    """Single-leaf convenience over range_inclusion_proofs."""
+    return range_inclusion_proofs(leaves, index, index + 1, length)[0]
+
+
+def verify_inclusion(
+    public_key: str,
+    leaf: bytes,
+    index: int,
+    length: int,
+    proof: List[bytes],
+    root_sig: bytes,
+) -> bool:
+    """Check a single leaf hash against a SIGNED promote-odd root at
+    `length` using an inclusion_proof. The signature binds (length,
+    root) to the feed key, so a verified sparse block is as trusted as
+    a contiguously replicated one."""
+    sizes = _peak_sizes(length)
+    off = 0
+    for peak_idx, size in enumerate(sizes):
+        if index < off + size:
+            break
+        off += size
+    else:
+        return False
+    k = size.bit_length() - 1  # path length inside the peak
+    if len(proof) != k + len(sizes) - 1:
+        return False
+    acc = leaf
+    p = index - off
+    for lvl in range(k):
+        sib = proof[lvl]
+        acc = _parent(acc, sib) if p % 2 == 0 else _parent(sib, acc)
+        p >>= 1
+    peaks = []
+    others = iter(proof[k:])
+    for j in range(len(sizes)):
+        peaks.append(acc if j == peak_idx else next(others))
+    root = peaks[-1]
+    for h in reversed(peaks[:-1]):
+        root = _parent(h, root)
+    return crypto.verify(
+        signable(length, root), root_sig, keymod.decode(public_key)
+    )
 
 
 def sign_chain(blocks: List[bytes], seed: bytes) -> bytes:

