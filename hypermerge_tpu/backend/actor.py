@@ -39,10 +39,16 @@ class Actor:
         self,
         feed: Feed,
         notify: Callable[[Dict[str, Any]], None],
+        defer_cache: Optional[Callable[["Actor"], None]] = None,
     ) -> None:
         self.id = feed.public_key
         self.feed = feed
         self._notify = notify
+        # when set, per-append sidecar encoding moves OFF the write's
+        # critical path: defer_cache(self) schedules a debounced
+        # sync_cache() instead (the sidecar is derived data — columns()
+        # catches up on demand, and blocks rebuild it after a crash)
+        self._defer_cache = defer_cache
         self._lock = threading.RLock()
         # slot per feed block: _UNSET until decoded; None = corrupt.
         # Lazily sized — feed.length forces the block-log scan, which a
@@ -101,7 +107,10 @@ class Actor:
                 return
             self.changes.append(change)
             self.feed.append(blockmod.pack(change.to_json()))
-            self._sync_cache_locked()
+            if self._defer_cache is None:
+                self._sync_cache_locked()
+        if self._defer_cache is not None:
+            self._defer_cache(self)
         # local writes don't re-notify sync: the doc already applied it
 
     def _on_append(self, index: int, data: bytes) -> None:
@@ -122,9 +131,12 @@ class Actor:
             else:
                 self.changes.append(_UNSET)
             self.changes[index] = self._parse_block(data, index)
-            self._sync_cache_locked()
+            if self._defer_cache is None:
+                self._sync_cache_locked()
             self._pending_dl[0] += len(data)
             self._pending_dl[1] += (time.perf_counter() - t0) * 1e3
+        if self._defer_cache is not None:
+            self._defer_cache(self)
         self._notify(
             {"type": "ActorSync", "actor": self, "origin": "append"}
         )
@@ -169,6 +181,12 @@ class Actor:
             n = 0
         for i in range(n, head):
             cc.append_change(self._get_change(i))
+
+    def sync_cache(self) -> None:
+        """Catch the columnar sidecar up to the feed head (the deferred
+        flush target; idempotent)."""
+        with self._lock:
+            self._sync_cache_locked()
 
     def columns(self) -> FeedColumns:
         """The feed as columnar arrays (the bulk cold-start input); the

@@ -126,6 +126,15 @@ class RepoBackend:
             / 1e3,
             name="syncs",
         )
+        # sidecar encoding rides OFF the interactive write path: the
+        # columnar cache is derived data, caught up by this flusher (or
+        # on demand by columns())
+        self._cache_syncs = Debouncer(
+            lambda actors: [a.sync_cache() for a in actors],
+            window_s=float(os.environ.get("HM_CACHE_FLUSH_MS", "5"))
+            / 1e3,
+            name="colcache",
+        )
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -740,7 +749,9 @@ class RepoBackend:
 
     def _init_actor(self, pair: keymod.KeyPair) -> Actor:
         feed = self.feeds.create(pair)
-        actor = Actor(feed, self._actor_notify)
+        actor = Actor(
+            feed, self._actor_notify, defer_cache=self._cache_syncs.mark
+        )
         with self._lock:
             self.actors[actor.id] = actor
         self._save_feed_info(feed)
@@ -753,7 +764,9 @@ class RepoBackend:
             actor = self.actors.get(actor_id)
         if actor is None:
             feed = self.feeds.open_feed(actor_id)
-            actor = Actor(feed, self._actor_notify)
+            actor = Actor(
+                feed, self._actor_notify, defer_cache=self._cache_syncs.mark
+            )
             with self._lock:
                 self.actors[actor_id] = actor
             self._save_feed_info(feed)
@@ -1012,6 +1025,7 @@ class RepoBackend:
         self._closed = True
         self._gossip.close()
         self._syncs.close()
+        self._cache_syncs.close()  # drains: sidecars durable on close
         if self._file_server is not None:
             self._file_server.close()
             self._file_server = None
