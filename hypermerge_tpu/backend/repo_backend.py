@@ -591,6 +591,11 @@ class RepoBackend:
         # kernel twin wins outright
         min_cells = int(os.environ.get("HM_DEVICE_MIN_CELLS", "131072"))
         stats = self.last_bulk_stats
+        # NOTE: slab packing stays SERIAL by design. It is CPU-bound
+        # numpy on a host with one shared core — thread-pooling it was
+        # measured (r5) to starve the device-tunnel feeder thread and
+        # balloon the fetch barrier 4x. On a multi-core host a pack
+        # pipeline would pay; this box is not one.
         for base in range(0, len(entries), slab):
             chunk = entries[base : base + slab]
             # bucket the doc axis (pow2) so every slab of a bulk load —

@@ -84,6 +84,17 @@ class Handle(Generic[T]):
     def change(self, fn: Callable[[Any], None], message: str = "") -> None:
         self._df.change(fn, message)
 
+    def fork(self) -> str:
+        """A new doc seeded with this one's state (reference
+        src/Handle.ts:21-23)."""
+        return self._df._repo.fork(self.url)
+
+    def merge(self, other: "Handle") -> "Handle[T]":
+        """Adopt `other`'s actors into this doc (reference
+        src/Handle.ts:33-36)."""
+        self._df._repo.merge(self.url, other.url)
+        return self
+
     def message(self, contents: Any) -> None:
         self._df.send_doc_message(contents)
 

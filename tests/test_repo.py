@@ -211,3 +211,20 @@ def test_open_unknown_doc_stays_pending(repo):
     with pytest.raises(TimeoutError):
         h.value(timeout=0.2)
     h.close()
+
+
+def test_handle_fork_and_merge_conveniences():
+    """Handle.fork()/merge() (reference src/Handle.ts:21-36)."""
+    repo = Repo(memory=True)
+    h = repo.open(repo.create({"a": 1}))
+    h2 = repo.open(h.fork())
+    assert h2.value() == {"a": 1}
+    h2.change(lambda d: d.__setitem__("b", 2))
+    h.merge(h2)
+    import time as _t
+
+    deadline = _t.time() + 10
+    while _t.time() < deadline and h.value().get("b") != 2:
+        _t.sleep(0.02)
+    assert h.value() == {"a": 1, "b": 2}
+    repo.close()
