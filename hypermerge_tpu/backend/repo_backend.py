@@ -981,12 +981,22 @@ class RepoBackend:
         if self.network is not None:
             self.network.announce_feed(feed)
 
+    def _forget_file_feed(self, feed) -> None:
+        """Undo _announce_file_feed for a speculative remote open that
+        fetched nothing (the FeedStore entry is already removed)."""
+        self.feed_info.delete(feed.public_key)
+        if self.network is not None:
+            self.network.leave(feed.discovery_id)
+
     def get_file_store(self) -> FileStore:
         """The repo's FileStore, swarm-wired for remote fetch; created
         on first use (with or without an HTTP file server)."""
         if self.file_store is None:
             self.file_store = FileStore(
-                self.feeds, announce=self._announce_file_feed
+                self.feeds,
+                announce=self._announce_file_feed,
+                forget=self._forget_file_feed,
+                remote_capable=lambda: self.network is not None,
             )
             # Completed uploads flow into the durable metadata ledger
             # (reference src/RepoBackend.ts:105-107 → Metadata.addFile).

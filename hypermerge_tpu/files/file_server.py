@@ -99,11 +99,24 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as exc:
             self._error(404, str(exc))
             return
+        remote_wait = 0.0
         try:
             header = self.store.header(file_id)
         except (FileNotFoundError, KeyError, ValueError) as exc:
-            self._error(404, str(exc))
-            return
+            # not held locally: a swarm-wired store can fetch it from a
+            # peer (reference behavior — file feeds replicate like any
+            # feed); bounded wait, then stream as blocks arrive
+            remote_wait = float(
+                os.environ.get("HM_FILE_FETCH_TIMEOUT_S", "15")
+            )
+            if not self.store.remote_capable() or remote_wait <= 0:
+                self._error(404, str(exc))
+                return
+            try:
+                header = self.store.header_wait(file_id, remote_wait)
+            except TimeoutError as texc:
+                self._error(404, str(texc))
+                return
         self.send_response(200)
         self.send_header("Content-Type", header.mime_type)
         self.send_header("Content-Length", str(header.size))
@@ -111,7 +124,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("X-Block-Count", str(header.blocks))
         self.end_headers()
         if send_body:
-            for chunk in self.store.read(file_id):
+            for chunk in self.store.read(file_id, timeout=remote_wait):
                 self.wfile.write(chunk)
 
     def _error(self, code: int, message: str) -> None:

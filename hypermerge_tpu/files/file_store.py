@@ -73,12 +73,25 @@ class FileStore:
         self,
         feeds: FeedStore,
         announce: Optional[Callable] = None,
+        forget: Optional[Callable] = None,
+        remote_capable: Optional[Callable[[], bool]] = None,
     ) -> None:
         self.feeds = feeds
         self.write_log: Queue = Queue("filestore:writelog")
         # called with each file feed we create or fetch so the owner
-        # (RepoBackend) can join the swarm + announce for replication
+        # (RepoBackend) can join the swarm + announce for replication;
+        # `forget` undoes that for a speculative feed that fetched
+        # nothing; `remote_capable` says whether a fetch could even
+        # succeed (a swarm is attached)
         self._announce = announce
+        self._forget = forget
+        self._remote_capable = remote_capable
+
+    def remote_capable(self) -> bool:
+        return (
+            self._announce is not None
+            and (self._remote_capable is None or self._remote_capable())
+        )
 
     def write(self, data: Chunkable, mime_type: str) -> FileHeader:
         pair = keymod.create()
@@ -161,6 +174,7 @@ class FileStore:
                     i += 1
                     continue
             if time.monotonic() > deadline:
+                self._forget_if_empty(file_id)
                 raise TimeoutError(
                     f"hyperfile {file_id}: incomplete after {timeout}s "
                     f"({feed.length} blocks)"
@@ -179,6 +193,20 @@ class FileStore:
             if self._announce is not None:
                 self._announce(feed)
         return feed
+
+    def _forget_if_empty(self, file_id: str) -> None:
+        """A speculative remote open that fetched NOTHING leaves no
+        trace: a bogus-id lookup must not permanently register/announce
+        a feed."""
+        feed = self.feeds.get_feed(file_id)
+        if (
+            feed is not None
+            and feed.length == 0
+            and not feed._sparse
+        ):
+            self.feeds.remove(file_id)
+            if self._forget is not None:
+                self._forget(feed)
 
     @staticmethod
     def _try_header(block: bytes) -> Optional[FileHeader]:
@@ -200,6 +228,7 @@ class FileStore:
                 ):
                     return hdr
             if time.monotonic() > deadline:
+                self._forget_if_empty(file_id)
                 raise TimeoutError(
                     f"hyperfile {file_id}: no complete header after "
                     f"{timeout}s ({feed.length} blocks)"

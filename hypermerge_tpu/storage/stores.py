@@ -361,6 +361,11 @@ class FeedInfoStore:
             [(p, d, 1 if w else 0) for p, d, w in rows],
         )
 
+    def delete(self, public_id: str) -> None:
+        self.db.execute(
+            "DELETE FROM feeds WHERE public_id=?", (public_id,)
+        )
+
     def all_public_ids(self) -> List[str]:
         return [r[0] for r in self.db.query("SELECT public_id FROM feeds")]
 

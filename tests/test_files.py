@@ -86,6 +86,52 @@ class TestRemoteFileFetch:
         finally:
             repo.close()
 
+    def test_http_server_fetches_remote_file(self):
+        """GET /hyperfile:/<id> on a swarm-wired file server for a file
+        a PEER holds: the server replicates it in and streams it
+        (reference: file feeds replicate like any feed)."""
+        ra, rb, sa, sb = self._tcp_pair()
+        sock = server_path()
+        try:
+            data = os.urandom(200_000)
+            header = ra.back.get_file_store().write(data, "text/plain")
+            rb.start_file_server(sock)
+            from hypermerge_tpu.files.file_client import FileServerClient
+
+            hdr2, got = FileServerClient(sock).read(header.url)
+            assert got == data
+            assert hdr2.sha256 == header.sha256
+            assert hdr2.mime_type == "text/plain"
+        finally:
+            ra.close()
+            rb.close()
+            sa.destroy()
+            sb.destroy()
+            if os.path.exists(sock):
+                os.remove(sock)
+
+    def test_failed_remote_fetch_leaves_no_trace(self):
+        """A bogus-id fetch on a SWARM-WIRED store times out AND cleans
+        up: no feed stays registered/announced for an id that yielded
+        nothing."""
+        from hypermerge_tpu.utils import keys as keymod
+        from hypermerge_tpu.utils.ids import to_hyperfile_url
+
+        ra, rb, sa, sb = self._tcp_pair()
+        try:
+            bogus = keymod.create().public_key
+            fid = url_to_id(to_hyperfile_url(bogus))
+            fs = rb.back.get_file_store()
+            with pytest.raises(TimeoutError):
+                fs.header_wait(fid, timeout=0.3)
+            assert rb.back.feeds.get_feed(fid) is None
+            assert fid not in rb.back.feed_info.all_public_ids()
+        finally:
+            ra.close()
+            rb.close()
+            sa.destroy()
+            sb.destroy()
+
     def test_local_read_semantics_unchanged(self):
         """timeout=0 keeps the strict local contract: missing feeds
         raise FileNotFoundError immediately."""
