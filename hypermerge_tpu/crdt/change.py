@@ -28,16 +28,18 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from enum import IntEnum
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # identities
 
 
-@dataclass(frozen=True, order=True)
-class OpId:
+class OpId(NamedTuple):
     """Lamport-ordered op identity. Ordering = (ctr, actor) — the conflict
-    tie-break used everywhere (host and device kernels must agree)."""
+    tie-break used everywhere (host and device kernels must agree).
+    A NamedTuple, not a dataclass: OpIds are hashed/compared millions of
+    times (opset dict keys, supersession maps) and tuple hash/eq run in
+    C — measurably faster on the interactive change path."""
 
     ctr: int
     actor: str
