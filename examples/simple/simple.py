@@ -1,0 +1,60 @@
+"""Two repos in one process replicating a doc — the reference's
+`examples/simple` (examples/simple/src/simple.ts): repoA creates a doc,
+both repos watch it, edits from each side converge through the swarm.
+
+    python examples/simple/simple.py
+"""
+
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+
+
+def main() -> None:
+    hub = LoopbackHub()
+    repo_a, repo_b = Repo(memory=True), Repo(memory=True)
+    repo_a.set_swarm(LoopbackSwarm(hub))
+    repo_b.set_swarm(LoopbackSwarm(hub))
+
+    doc_url = repo_a.create({"numbers": [2, 3, 4]})
+    done_a, done_b = threading.Event(), threading.Event()
+
+    def watcher(name, done):
+        def on_change(state, _i) -> None:
+            print(name, state)
+            if state and len(state.get("numbers", [])) == 5:
+                done.set()
+
+        return on_change
+
+    repo_a.watch(doc_url, watcher("RepoA", done_a))
+    repo_b.watch(doc_url, watcher("RepoB", done_b))
+
+    repo_a.change(
+        doc_url,
+        lambda d: (d["numbers"].append(5), d.__setitem__("foo", "bar")),
+    )
+    repo_b.change(
+        doc_url,
+        lambda d: (
+            d["numbers"].insert(0, 1),
+            d.__setitem__("bar", "foo"),
+        ),
+    )
+
+    if not (done_a.wait(timeout=15) and done_b.wait(timeout=15)):
+        raise SystemExit("did not converge")
+    a, b = repo_a.doc(doc_url), repo_b.doc(doc_url)
+    assert a == b, (a, b)
+    print("converged:", a)
+    repo_a.close()
+    repo_b.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -187,3 +187,18 @@ def test_chat_example_end_to_end(tmp_path):
             serve.wait(timeout=30)
         except subprocess.TimeoutExpired:
             serve.kill()
+
+
+def test_simple_example_converges():
+    """examples/simple mirrors the reference's two-repo watch demo
+    (reference examples/simple/src/simple.ts)."""
+    out = subprocess.run(
+        [sys.executable, "examples/simple/simple.py"],
+        capture_output=True,
+        text=True,
+        cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "converged: {'numbers': [1, 2, 3, 4, 5]" in out.stdout
