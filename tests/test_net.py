@@ -574,6 +574,15 @@ class TestChurn:
         rch = peer.connection.open_channel("Replication")
         rch.send({"type": "Blocks", "id": "nope", "from": "NaN", "blocks": 3})
         rch.send({"type": "FeedLength"})
+        # sparse-fetch surface: malformed ranges, bogus proofs, junk b64
+        rch.send({"type": "RequestRange", "id": "nope", "from": 0})
+        rch.send({"type": "RequestRange", "id": "nope", "from": -5,
+                  "to": "many", "cap": 7})
+        rch.send({"type": "SparseBlocks", "id": "nope", "from": 0,
+                  "len": 1, "sig": "!!notb64!!", "blocks": ["@@"],
+                  "proofs": [[]]})
+        rch.send({"type": "SparseBlocks", "id": "nope", "from": 0,
+                  "len": "x", "sig": None, "blocks": 1, "proofs": {}})
         # sync still works afterwards
         ra.change(url, lambda d: d.__setitem__("x", 2))
         wait_until(lambda: rb.doc(url).get("x") == 2)

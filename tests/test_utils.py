@@ -298,3 +298,34 @@ class TestDebouncer:
         assert done
         t.join(5)
         d.close()
+
+
+def test_debouncer_adaptive_window_stretches_under_load():
+    """With max_window_s set, a slow flush stretches the next window so
+    batches grow instead of flush count (the replication live tail's
+    self-balancing behavior)."""
+    import threading as _th
+    import time as _t
+
+    from hypermerge_tpu.utils.debounce import Debouncer
+
+    batches = []
+
+    def slow_flush(batch):
+        batches.append(dict(batch))
+        _t.sleep(0.05)  # flushing is slower than the floor window
+
+    d = Debouncer(slow_flush, window_s=0.001, max_window_s=0.2)
+    stop = _t.monotonic() + 0.5
+    i = 0
+    while _t.monotonic() < stop:
+        d.mark(i % 4, i)
+        i += 1
+        _t.sleep(0.001)
+    d.flush_now(timeout=5)
+    d.close()
+    total_marks = sum(len(b) for b in batches)
+    assert total_marks >= 4  # all keys flushed at least once
+    # with ~0.05s flushes over 0.5s, a non-adaptive 1ms window would do
+    # hundreds of flushes; adaptation caps it near duration/flush_time
+    assert len(batches) <= 14, len(batches)
