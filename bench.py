@@ -186,6 +186,40 @@ def _config5_union(n_docs=100_000, n_actors=64, seed=0, dirty=1000):
     return dt * 1e3  # ms
 
 
+def _config3_multiactor(n_docs=1024, n_ops=512):
+    """BASELINE config 3: 1k synthetic docs x 3 concurrent actors x
+    ~500 ops (LWW map + RGA list mix), batched through the device
+    kernel. Unlike the single-writer corpus (configs 4), this drives
+    the GENERAL sorted-composite pack path and the multi-actor
+    tie-break lanes. Timed: warm materialize + liveness/clock fetch to
+    host (the render barrier). Correctness for this shape is pinned by
+    tests/test_device_materialize.py fuzz vs OpSet."""
+    import numpy as np
+
+    from hypermerge_tpu.ops.materialize import materialize_batch
+    from hypermerge_tpu.ops.synth import synth_changes
+
+    histories = [
+        synth_changes(
+            n_ops, n_actors=3, ops_per_change=8, text_frac=0.5, seed=s
+        )
+        for s in range(n_docs)
+    ]
+
+    def full_pass():
+        dec = materialize_batch(histories)
+        np.asarray(dec.elem_live)
+        np.asarray(dec.clock)
+        return dec
+
+    full_pass()  # compile + warm
+    t0 = time.perf_counter()
+    dec = full_pass()
+    dt = time.perf_counter() - t0
+    assert dec.clock_dict(0), "empty clock"
+    return dt, n_docs * n_ops / dt
+
+
 def _tunnel_rtt_ms():
     """The device link's dispatch+fetch round-trip floor, measured on a
     64-int array (payload-independent). On the tunneled bench box this
@@ -395,6 +429,13 @@ def main() -> None:
             f"({cfg2[1]:,.0f} edits/s replicated+applied)",
             file=sys.stderr,
         )
+    cfg3 = _soft("config3", _config3_multiactor)
+    if cfg3 is not None:
+        print(
+            f"# config3 1k docs x 3 actors x 512 ops (general pack "
+            f"path): {cfg3[0]:.2f}s -> {cfg3[1]:,.0f} ops/s",
+            file=sys.stderr,
+        )
     rtt = _soft("tunnel_rtt", _tunnel_rtt_ms)
     if rtt is not None:
         print(
@@ -441,6 +482,9 @@ def main() -> None:
                     ),
                     "config2_convergence_s": (
                         round(cfg2[0], 2) if cfg2 is not None else None
+                    ),
+                    "config3_multiactor_ops_per_s": (
+                        round(cfg3[1]) if cfg3 is not None else None
                     ),
                     "config5_union_100k_ms": (
                         round(cfg5, 1) if cfg5 is not None else None
