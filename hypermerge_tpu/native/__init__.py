@@ -96,6 +96,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
         ctypes.c_size_t,
     ]
+    # columnar pack entry points are OPTIONAL: a prebuilt .so from an
+    # older tree (no compiler to rebuild with) must keep serving crypto
+    # + codec rather than disabling the whole native layer
+    try:
+        ll = ctypes.c_longlong
+        lib.hm_pack_value_minmax.restype = ctypes.c_int
+        lib.hm_pack_value_minmax.argtypes = [ll] + [ctypes.c_void_p] * 12
+        lib.hm_pack_prefix.restype = ctypes.c_int
+        lib.hm_pack_prefix.argtypes = [ll, ll, ll] + [ctypes.c_void_p] * 16
+        lib._has_pack = True
+    except AttributeError:
+        lib._has_pack = False
     return lib
 
 
@@ -128,6 +140,15 @@ def load() -> Optional[ctypes.CDLL]:
 def caps() -> int:
     lib = load()
     return lib.hm_caps() if lib is not None else 0
+
+
+def pack_lib() -> Optional[ctypes.CDLL]:
+    """The library handle iff it carries the columnar pack entry points
+    (ops/columnar.py native fast path); None otherwise."""
+    lib = load()
+    if lib is None or not getattr(lib, "_has_pack", False):
+        return None
+    return lib
 
 
 def available() -> bool:

@@ -70,25 +70,49 @@ def serve_backend(
         return back
 
     back = build_backend()
-    while True:
-        conn, _ = server.accept()
-        duplex = TcpDuplex(conn, is_client=False)
-        if duplex.closed:
-            # failed handshake (probe, health check, misconfigured
-            # client): this was not the frontend — the LIVE backend,
-            # its swarm, and its replicated state stay untouched
-            continue
-        back.subscribe(duplex.send)
-        duplex.on_message(back.receive)
-        gone = threading.Event()
-        duplex.on_close(gone.set)
-        gone.wait()
+    idle_sink = False  # a discard sink is attached between frontends
+    try:
+        while True:
+            conn, _ = server.accept()
+            duplex = TcpDuplex(conn, is_client=False)
+            if duplex.closed:
+                # failed handshake (probe, health check, misconfigured
+                # client): this was not the frontend — the LIVE backend,
+                # its swarm, and its replicated state stay untouched
+                continue
+            if idle_sink:
+                # swap the discard sink for the real frontend; drop the
+                # handful of messages a push could buffer in the swap
+                # window (a PREVIOUS frontend's replies/patches must
+                # never reach this one — its queryId counter restarts)
+                back.to_frontend.unsubscribe()
+                back.to_frontend.drain()
+                idle_sink = False
+            back.subscribe(duplex.send)
+            duplex.on_message(back.receive)
+            gone = threading.Event()
+            duplex.on_close(gone.set)
+            gone.wait()
+            if once:
+                return
+            # non-once: REUSE the live backend for the next frontend —
+            # closing + rebuilding per cycle would rebind the advertised
+            # swarm port (stranding --connect peers), drop a :memory:
+            # repo's replicated state, and spin up a fresh set of
+            # debouncer threads/device caches every cycle. While no
+            # frontend is attached, a DISCARD sink consumes pushes
+            # (swarm-replicated patches, gossip) so the queue cannot
+            # grow without bound on an idle daemon; the next frontend
+            # opens its docs fresh and gets its own Ready/patch stream.
+            back.to_frontend.unsubscribe()
+            back.to_frontend.drain()
+            back.subscribe(lambda _msg: None)
+            idle_sink = True
+    finally:
         back.close()
-        if once:
-            server.close()
+        server.close()
+        if os.path.exists(sock_path):
             os.remove(sock_path)
-            return
-        back = build_backend()
 
 
 def connect_frontend(
@@ -128,11 +152,18 @@ def main() -> None:
         "--connect", action="append", default=[], metavar="HOST:PORT",
         help="join the peer swarm: dial another backend (repeatable)",
     )
+    ap.add_argument(
+        "--persist", action="store_true",
+        help="keep serving after a frontend disconnects (ONE live "
+        "backend is reused across frontend cycles: swarm port and "
+        "replicated state persist)",
+    )
     args = ap.parse_args()
     serve_backend(
         args.sock_path,
         repo_path=None if args.repo_path == ":memory:" else args.repo_path,
         memory=args.repo_path == ":memory:",
+        once=not args.persist,
         tcp_listen=args.listen,
         tcp_connect=args.connect,
     )

@@ -108,6 +108,11 @@ class ReplicationManager:
         # must prove against) and theirs (what we prove against)
         self._challenge_local: Dict[NetworkPeer, bytes] = {}
         self._challenge_remote: Dict[NetworkPeer, bytes] = {}
+        # outstanding sparse-fetch indices per feed: only blocks WE
+        # asked for may land in the sparse buffer — an unsolicited
+        # SparseBlocks push (even with valid proofs) must not grow
+        # memory on a peer that never requested it
+        self._sparse_wanted: Dict[str, Set[int]] = {}
         # live-tail coalescing: public_key -> earliest unflushed block,
         # adaptive window (batches grow under sustained load instead of
         # frame count), drained on close
@@ -509,6 +514,19 @@ class ReplicationManager:
             if challenge is None:
                 continue
             binding, we_are_client = self._session_binding(peer)
+            with self._lock:
+                w = self._sparse_wanted.setdefault(discovery_id, set())
+                w.update(range(start, end))
+                # unanswered requests must not leak for the process
+                # lifetime (a peer may vanish before serving): bound the
+                # outstanding set, shedding the indices FURTHEST out —
+                # the same near-head-first policy as the sparse buffer
+                cap = int(
+                    os.environ.get("HM_SPARSE_WANTED_CAP", "8192")
+                )
+                if len(w) > cap:
+                    for i in sorted(w, reverse=True)[: len(w) - cap]:
+                        w.discard(i)
             self._send(peer, {
                 "type": "RequestRange",
                 "id": discovery_id,
@@ -579,13 +597,26 @@ class ReplicationManager:
         feed = self.feeds.by_discovery_id(did)
         if feed is None or len(blocks) != len(proofs):
             return
+        with self._lock:
+            wanted = self._sparse_wanted.get(did)
+        if not wanted:
+            log(
+                "replication",
+                f"DROPPED unsolicited sparse blocks for "
+                f"{feed.public_key[:6]} from {peer.id[:6]}",
+            )
+            return
         sig = base64.b64decode(sig_b64)
         for i, (b64, proof64) in enumerate(zip(blocks, proofs)):
+            index = start + i
+            with self._lock:
+                if index not in wanted:
+                    continue  # not an index we asked for: never lands
             raw = base64.b64decode(b64)
             ok = verify_inclusion(
                 feed.public_key,
                 crypto.leaf_hash(raw),
-                start + i,
+                index,
                 length,
                 [base64.b64decode(h) for h in proof64],
                 sig,
@@ -593,12 +624,21 @@ class ReplicationManager:
             if not ok:
                 log(
                     "replication",
-                    f"REJECTED sparse block {start + i} of "
+                    f"REJECTED sparse block {index} of "
                     f"{feed.public_key[:6]} from {peer.id[:6]}: "
                     "bad inclusion proof",
                 )
                 return
-            feed.put_sparse(start + i, raw)
+            if not feed.put_sparse(index, raw):
+                continue  # sparse cap dropped it: stays outstanding so
+                # a later re-serve of the re-issued request is accepted
+            with self._lock:
+                wanted.discard(index)
+                # only retire the mapping if OUR set still backs it — a
+                # concurrent request_range may have installed a fresh
+                # set that must keep accepting its own response
+                if not wanted and self._sparse_wanted.get(did) is wanted:
+                    self._sparse_wanted.pop(did, None)
 
     def _tail(self, feed: Feed) -> None:
         with self._lock:

@@ -33,6 +33,7 @@ floats (float64 — no precision loss through the device path), bigints.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -362,6 +363,150 @@ def _prefix_single_ok(fc) -> bool:
     return ok
 
 
+_DT_CODE = {
+    np.dtype(np.int8): 0,
+    np.dtype(np.int16): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.uint8): 3,
+}
+
+# source plane order of the native pack entry (hm_native.cpp hm_pack_prefix)
+_PACK_SRC_PLANES = (
+    "action", "ctr", "seq", "obj_ctr", "obj_a", "key",
+    "ref_ctr", "ref_a", "insert", "vkind", "value", "dt",
+)
+
+_pack_src_idx_cache: Optional[np.ndarray] = None
+
+
+def _pack_src_idx() -> np.ndarray:
+    """Indices of the native pack's source planes within the sidecar's
+    PLANE_NAMES order (what FeedColumns.plane_meta offsets follow)."""
+    global _pack_src_idx_cache
+    if _pack_src_idx_cache is None:
+        from ..storage.colcache import PLANE_NAMES
+
+        _pack_src_idx_cache = np.asarray(
+            [PLANE_NAMES.index(n) for n in _PACK_SRC_PLANES], np.int64
+        )
+    return _pack_src_idx_cache
+
+
+def _native_pack_lib():
+    if os.environ.get("HM_NATIVE_PACK", "1") == "0":
+        return None
+    from .. import native
+
+    return native.pack_lib()
+
+
+def _pack_wire_dtypes(i16ok, row_dt, kdt, vmin, vmax):
+    return {
+        "action": np.uint8,
+        "insert": np.uint8,
+        "vkind": np.uint8,
+        "dt": np.uint8,
+        "actor": np.int32,  # batch-global ids (host/decode only)
+        "ctr": row_dt,
+        "seq": row_dt,
+        "obj": row_dt,
+        "key": kdt,
+        "ref": row_dt,
+        "value": (
+            np.int16
+            if i16ok and -(2**15) <= vmin and vmax < 2**15
+            else np.int32
+        ),
+    }
+
+
+def _native_pack_prefix(
+    lib, fcs, fc_idx_a, ends, writer_g, flat_lut,
+    D, Dp, N, i16ok, row_dt, kdt,
+) -> Dict[str, np.ndarray]:
+    """Emit the padded [Dp, N] column planes through the C++ batch entry
+    point: per-feed narrow plane pointers in, preallocated output buffers
+    filled in place (real rows AND pad cells — no np.full prepass, no [M]
+    intermediates). Returns {} when a plane can't be described to the
+    native ABI (caller falls back to the numpy twin)."""
+    F = len(fcs)
+    srcs = np.empty((F, len(_PACK_SRC_PLANES)), np.int64)
+    sdts = np.empty((F, len(_PACK_SRC_PLANES)), np.uint8)
+    keep_alive = []  # converted planes must outlive the call
+    src_idx = _pack_src_idx()
+    for i, fc in enumerate(fcs):
+        meta = fc.plane_meta
+        if meta is not None:
+            # every plane is a slice of one checkpoint buffer: all 12
+            # pointers derive from the base address in two gathers
+            base_addr, offs, dts = meta[0], meta[1], meta[2]
+            srcs[i] = base_addr + offs[src_idx]
+            sdts[i] = dts[src_idx]
+            keep_alive.append(meta)
+            continue
+        planes = fc.planes
+        for j, name in enumerate(_PACK_SRC_PLANES):
+            p = planes[name]
+            code = _DT_CODE.get(p.dtype)
+            if code is None or not p.flags["C_CONTIGUOUS"]:
+                p = np.ascontiguousarray(p, np.int32)
+                keep_alive.append(p)
+                code = 2
+            srcs[i, j] = p.__array_interface__["data"][0]
+            sdts[i, j] = code
+
+    # a corrupt sidecar whose row_ends overrun its planes must not reach
+    # the C loops (the numpy twin fails loudly on the length mismatch)
+    feed_rows = np.asarray([fc.n_rows for fc in fcs], np.int64)
+    if np.any(ends > feed_rows[fc_idx_a]):
+        return {}
+
+    klut, koffs = flat_lut("k")
+    slut, soffs = flat_lut("s")
+    flut, foffs = flat_lut("f")
+    blut, boffs = flat_lut("b")
+    lut_lens = np.asarray(
+        [len(klut), len(slut), len(flut), len(blut)], np.int64
+    )
+    writer_g = np.ascontiguousarray(writer_g, np.int64)
+    ends = np.ascontiguousarray(ends, np.int64)
+    fc_idx_a = np.ascontiguousarray(fc_idx_a, np.int64)
+
+    def ptr(a):
+        return a.__array_interface__["data"][0]
+
+    mm = np.zeros(2, np.int64)
+    rc = lib.hm_pack_value_minmax(
+        D, ptr(fc_idx_a), ptr(ends), ptr(srcs), ptr(sdts),
+        ptr(slut), ptr(soffs), ptr(flut), ptr(foffs), ptr(blut),
+        ptr(boffs), ptr(lut_lens), ptr(mm),
+    )
+    if rc != 0:
+        return {}
+    dtypes = _pack_wire_dtypes(i16ok, row_dt, kdt, int(mm[0]), int(mm[1]))
+
+    cols: Dict[str, np.ndarray] = {}
+    out_ptrs = np.empty(len(COLUMNS), np.int64)
+    out_dts = np.empty(len(COLUMNS), np.uint8)
+    for ci, name in enumerate(COLUMNS):
+        arr = np.empty(Dp * N, dtypes[name])
+        cols[name] = arr
+        out_ptrs[ci] = arr.__array_interface__["data"][0]
+        out_dts[ci] = _DT_CODE[arr.dtype]
+    rc = lib.hm_pack_prefix(
+        D, Dp, N, ptr(fc_idx_a), ptr(ends), ptr(srcs), ptr(sdts),
+        ptr(klut), ptr(koffs), ptr(slut), ptr(soffs), ptr(flut),
+        ptr(foffs), ptr(blut), ptr(boffs), ptr(lut_lens),
+        ptr(writer_g), ptr(out_ptrs), ptr(out_dts),
+    )
+    del keep_alive
+    if rc != 0:
+        return {}
+    return {
+        name: cols[name].reshape(Dp, N) for name in COLUMNS
+    }
+
+
 def _try_pack_prefix_single(
     doc_specs, n_rows, n_pred, n_docs
 ) -> Optional[ColumnarBatch]:
@@ -371,7 +516,14 @@ def _try_pack_prefix_single(
     lamport property: a referenced op always has a smaller ctr), so this
     path needs ZERO sorts and no drop fixpoint — the general path's two
     M-sized argsorts and composite-key resolution collapse into one
-    searchsorted over an already-sorted key."""
+    searchsorted over an already-sorted key.
+
+    The padded-plane emit itself has two bit-identical twins: the C++
+    batch entry point (native/src/hm_native.cpp hm_pack_prefix — one
+    fused pass per column straight from the feeds' narrow planes into
+    preallocated output buffers) and the numpy scatter below (the
+    fallback when the native layer is absent, HM_NATIVE_PACK=0, or a
+    feed is not plane-backed)."""
     for spec in doc_specs:
         if len(spec) != 1:
             return None
@@ -395,7 +547,11 @@ def _try_pack_prefix_single(
         fc_idx.append(i)
         ends[d] = fc.window(0, e)[1]
 
-    # -- global tables (same interning as the general path) -------------
+    # -- global tables (same interning as the general path). Feeds
+    # instantiated from shared templates carry IDENTICAL local tables,
+    # so the per-item interning loop memoizes on the table tuple — the
+    # global id sequence is unchanged (a memo hit means every item was
+    # already interned, in the same order).
     actor_int = _Interner()
     key_int = _Interner()
     str_int = _Interner()
@@ -403,22 +559,30 @@ def _try_pack_prefix_single(
     big_int = _Interner()
     luts = {"k": [], "s": [], "f": [], "b": []}
     writers: List[int] = []
+    lut_memo: Dict[Any, np.ndarray] = {}
+
+    def lut_of(kind, interner, items):
+        key = (kind, tuple(items))
+        got = lut_memo.get(key)
+        if got is None:
+            got = np.asarray([interner(x) for x in items], np.int64)
+            lut_memo[key] = got
+        return got
+
+    writer_memo: Dict[Any, int] = {}
     for fc in fcs:
-        for x in fc.actors:
-            actor_int(x)
-        writers.append(actor_int(fc.actors[0]) if fc.actors else 0)
-        luts["k"].append(
-            np.asarray([key_int(x) for x in fc.keys], np.int64)
-        )
-        luts["s"].append(
-            np.asarray([str_int(x) for x in fc.strings], np.int64)
-        )
-        luts["f"].append(
-            np.asarray([float_int(x) for x in fc.floats], np.int64)
-        )
-        luts["b"].append(
-            np.asarray([big_int(x) for x in fc.bigints], np.int64)
-        )
+        akey = tuple(fc.actors)
+        w = writer_memo.get(akey)
+        if w is None:
+            for x in fc.actors:
+                actor_int(x)
+            w = actor_int(fc.actors[0]) if fc.actors else 0
+            writer_memo[akey] = w
+        writers.append(w)
+        luts["k"].append(lut_of("k", key_int, fc.keys))
+        luts["s"].append(lut_of("s", str_int, fc.strings))
+        luts["f"].append(lut_of("f", float_int, fc.floats))
+        luts["b"].append(lut_of("b", big_int, fc.bigints))
     sorted_actors = sorted(actor_int.items)
     rank_of = {name: i for i, name in enumerate(sorted_actors)}
     arank = np.asarray(
@@ -439,50 +603,33 @@ def _try_pack_prefix_single(
         )
 
     fc_idx_a = np.asarray(fc_idx, np.int64)
-    doc_col = np.repeat(np.arange(D, dtype=np.int64), ends)
-    doc_starts = np.zeros(D + 1, np.int64)
-    np.cumsum(ends, out=doc_starts[1:])
-    pos = (np.arange(M, dtype=np.int64) - doc_starts[doc_col]).astype(
-        np.int32
-    )
 
     from ..storage.colcache import OBJ_ROOT, REF_HEAD, REF_NONE
 
-    # column sources: v3 plane-backed feeds serve each column as a
-    # contiguous narrow array (concat promotes mixed widths); v2 feeds
-    # fall back to strided slices of the dense row matrix. The narrow
-    # path moves a fraction of the bytes — on a 10M-row bulk pack the
-    # difference is seconds of single-core memcpy.
-    use_planes = all(fc.planes is not None for fc in fcs)
-    if use_planes:
-        def col(name):
-            return np.concatenate(
-                [fcs[fc_idx[d]].plane(name)[: ends[d]] for d in range(D)]
-            )
-    else:
-        R = np.concatenate(
-            [fcs[fc_idx[d]].ensure_rows()[: ends[d]] for d in range(D)],
-            axis=0,
-        )
-        from ..storage.colcache import PLANE_NAMES
-
-        def col(name):
-            return R[:, PLANE_NAMES.index(name)]
-
     # -- preds ----------------------------------------------------------
-    pr_doc_l: List[np.ndarray] = []
+    pr_docs_l: List[int] = []
+    pr_cnt_l: List[int] = []
     pr_rows: List[np.ndarray] = []
     for d in range(D):
         fc = fcs[fc_idx[d]]
-        if not len(fc.preds):
+        n_pr = len(fc.preds)
+        if not n_pr:
             continue
-        phi = int(np.searchsorted(fc.preds[:, 0], ends[d], side="left"))
+        e = int(ends[d])
+        phi = (
+            n_pr  # whole-prefix window: every pred src is inside it
+            if e >= fc.n_rows
+            else int(np.searchsorted(fc.preds[:, 0], e, side="left"))
+        )
         if phi:
             pr_rows.append(fc.preds[:phi])
-            pr_doc_l.append(np.full(phi, d, np.int64))
+            pr_docs_l.append(d)
+            pr_cnt_l.append(phi)
     if pr_rows:
         PR = np.concatenate(pr_rows, axis=0)
-        pr_doc = np.concatenate(pr_doc_l)
+        pr_doc = np.repeat(
+            np.asarray(pr_docs_l, np.int64), np.asarray(pr_cnt_l, np.int64)
+        )
         p_src_row = PR[:, 0].astype(np.int64)  # feed row == doc row
         p_tgt_row = PR[:, 1].astype(np.int64) - 1  # dense ctr -> row
         pred_counts = np.bincount(pr_doc, minlength=Dp).astype(np.int64)
@@ -502,28 +649,15 @@ def _try_pack_prefix_single(
         raise ValueError(
             f"doc exceeds bucket: ops {max_ops}>{N} or preds {max_preds}>{P}"
         )
-    flat_idx = doc_col * N + pos
 
-    # -- derived columns, computed in (near-)wire dtypes ----------------
+    # wire dtypes are a function of the bucket + value ranges so native
+    # and numpy twins allocate identically (host_args passes the planes
+    # through copy-free): everything row-indexed fits int16 when N < 32k
+    # — the common case — and flags planes fit uint8
     i16ok = N < 2**15
     row_dt = np.int16 if i16ok else np.int32
+    kdt = np.int16 if len(key_int.items) < 2**15 else np.int32
 
-    obj_a = col("obj_a")
-    obj_row = np.where(
-        obj_a == 0, col("obj_ctr").astype(row_dt) - 1, row_dt(OBJ_ROOT)
-    )
-    del obj_a
-    ref_a = col("ref_a")
-    ref_row = np.where(
-        ref_a == 0,
-        col("ref_ctr").astype(row_dt) - 1,
-        np.where(
-            ref_a == -2, row_dt(REF_HEAD), row_dt(REF_NONE)
-        ).astype(row_dt),
-    )
-    del ref_a
-
-    # -- key/value global remap -----------------------------------------
     def flat_lut(kind):
         offs = np.zeros(len(fcs) + 1, np.int64)
         for i, l in enumerate(luts[kind]):
@@ -535,60 +669,99 @@ def _try_pack_prefix_single(
         )
         return flat, offs
 
-    klut, koffs = flat_lut("k")
-    kdt = np.int16 if len(key_int.items) < 2**15 else np.int32
-    key_l = col("key").astype(np.int64)
-    off_doc = np.repeat(koffs[fc_idx_a], ends)
-    safe = np.minimum(np.maximum(off_doc + key_l, 0), len(klut) - 1)
-    key_g = np.where(key_l >= 0, klut[safe].astype(kdt), kdt(-1))
-    del safe, off_doc, key_l
-    vkind = col("vkind")
-    value_g = col("value").astype(np.int64)
-    from ..storage.colcache import VK_BIGINT, VK_FLOAT, VK_STR
-
-    for code, kind in ((VK_STR, "s"), (VK_FLOAT, "f"), (VK_BIGINT, "b")):
-        m = vkind == code
-        if m.any():
-            lut, offs = flat_lut(kind)
-            oc = np.repeat(offs[fc_idx_a], ends)
-            value_g[m] = lut[oc[m] + value_g[m]]
-
-    # -- scatter into padded [Dp, N] ------------------------------------
+    use_planes = all(fc.planes is not None for fc in fcs)
+    native_lib = _native_pack_lib() if use_planes else None
     cols: Dict[str, np.ndarray] = {}
-    defaults = {"action": PAD, "obj": -1, "key": -1, "ref": -3}
-    sources = {
-        "action": col("action"),
-        "actor": np.repeat(writer_g[fc_idx_a], ends),
-        "ctr": col("ctr"), "seq": col("seq"), "obj": obj_row,
-        "key": key_g, "ref": ref_row, "insert": col("insert"),
-        "vkind": vkind, "value": value_g, "dt": col("dt"),
-    }
-    # allocate the device wire dtypes directly (host_args then passes
-    # them through copy-free): everything row-indexed fits int16 when
-    # N < 32k — the common case — and flags planes fit uint8
-    vmin = int(value_g.min(initial=0))
-    vmax = int(value_g.max(initial=0))
-    dtypes = {
-        "action": np.uint8,
-        "insert": np.uint8,
-        "vkind": np.uint8,
-        "dt": np.uint8,
-        "actor": np.int32,  # batch-global ids (host/decode only)
-        "ctr": row_dt,
-        "seq": row_dt,
-        "obj": row_dt,
-        "key": kdt,
-        "ref": row_dt,
-        "value": (
-            np.int16
-            if i16ok and -(2**15) <= vmin and vmax < 2**15
-            else np.int32
-        ),
-    }
-    for name in COLUMNS:
-        flat = np.full(Dp * N, defaults.get(name, 0), dtypes[name])
-        flat[flat_idx] = sources[name]
-        cols[name] = flat.reshape(Dp, N)
+
+    if native_lib is not None:
+        cols = _native_pack_prefix(
+            native_lib, fcs, fc_idx_a, ends, writer_g, flat_lut,
+            D, Dp, N, i16ok, row_dt, kdt,
+        )
+
+    if not cols:  # numpy twin (fallback, and the fuzz reference)
+        doc_col = np.repeat(np.arange(D, dtype=np.int64), ends)
+        doc_starts = np.zeros(D + 1, np.int64)
+        np.cumsum(ends, out=doc_starts[1:])
+        pos = (
+            np.arange(M, dtype=np.int64) - doc_starts[doc_col]
+        ).astype(np.int32)
+        flat_idx = doc_col * N + pos
+
+        # column sources: v3 plane-backed feeds serve each column as a
+        # contiguous narrow array (concat promotes mixed widths); v2
+        # feeds fall back to strided slices of the dense row matrix.
+        if use_planes:
+            def col(name):
+                return np.concatenate(
+                    [
+                        fcs[fc_idx[d]].plane(name)[: ends[d]]
+                        for d in range(D)
+                    ]
+                )
+        else:
+            R = np.concatenate(
+                [
+                    fcs[fc_idx[d]].ensure_rows()[: ends[d]]
+                    for d in range(D)
+                ],
+                axis=0,
+            )
+            from ..storage.colcache import PLANE_NAMES
+
+            def col(name):
+                return R[:, PLANE_NAMES.index(name)]
+
+        # -- derived columns, computed in (near-)wire dtypes ------------
+        obj_a = col("obj_a")
+        obj_row = np.where(
+            obj_a == 0, col("obj_ctr").astype(row_dt) - 1, row_dt(OBJ_ROOT)
+        )
+        del obj_a
+        ref_a = col("ref_a")
+        ref_row = np.where(
+            ref_a == 0,
+            col("ref_ctr").astype(row_dt) - 1,
+            np.where(
+                ref_a == -2, row_dt(REF_HEAD), row_dt(REF_NONE)
+            ).astype(row_dt),
+        )
+        del ref_a
+
+        # -- key/value global remap -------------------------------------
+        klut, koffs = flat_lut("k")
+        key_l = col("key").astype(np.int64)
+        off_doc = np.repeat(koffs[fc_idx_a], ends)
+        safe = np.minimum(np.maximum(off_doc + key_l, 0), len(klut) - 1)
+        key_g = np.where(key_l >= 0, klut[safe].astype(kdt), kdt(-1))
+        del safe, off_doc, key_l
+        vkind = col("vkind")
+        value_g = col("value").astype(np.int64)
+        from ..storage.colcache import VK_BIGINT, VK_FLOAT, VK_STR
+
+        for code, kind in ((VK_STR, "s"), (VK_FLOAT, "f"), (VK_BIGINT, "b")):
+            m = vkind == code
+            if m.any():
+                lut, offs = flat_lut(kind)
+                oc = np.repeat(offs[fc_idx_a], ends)
+                value_g[m] = lut[oc[m] + value_g[m]]
+
+        # -- scatter into padded [Dp, N] --------------------------------
+        defaults = {"action": PAD, "obj": -1, "key": -1, "ref": -3}
+        sources = {
+            "action": col("action"),
+            "actor": np.repeat(writer_g[fc_idx_a], ends),
+            "ctr": col("ctr"), "seq": col("seq"), "obj": obj_row,
+            "key": key_g, "ref": ref_row, "insert": col("insert"),
+            "vkind": vkind, "value": value_g, "dt": col("dt"),
+        }
+        vmin = int(value_g.min(initial=0))
+        vmax = int(value_g.max(initial=0))
+        dtypes = _pack_wire_dtypes(i16ok, row_dt, kdt, vmin, vmax)
+        for name in COLUMNS:
+            flat = np.full(Dp * N, defaults.get(name, 0), dtypes[name])
+            flat[flat_idx] = sources[name]
+            cols[name] = flat.reshape(Dp, N)
     pdt = np.int16 if i16ok else np.int32
     psrc = np.full(Dp * P, -1, pdt)
     ptgt = np.full(Dp * P, -1, pdt)

@@ -88,7 +88,8 @@ class _Template:
 
 
 def _write_doc(
-    feeds_root: str, pair: keymod.KeyPair, tpl: _Template, sign: bool
+    feeds_root: str, pair: keymod.KeyPair, tpl: _Template, sign: bool,
+    slab=None,
 ) -> None:
     from ..storage.integrity import sign_chain
 
@@ -117,10 +118,18 @@ def _write_doc(
     if sign:
         with open(os.path.join(d, pk + ".sig"), "wb") as fh:
             fh.write(sign_chain(blocks, keymod.decode(pair.secret_key)))
-    # single-file sidecar: one v3 checkpoint with this doc's writer
-    # substituted in the tables blob (everything else is doc-invariant)
-    with open(os.path.join(d, pk + ".cols2"), "wb") as fh:
-        fh.write(tpl.checkpoint_bytes(pk))
+    # columnar sidecar: one v3 checkpoint with this doc's writer
+    # substituted in the tables blob (everything else is doc-invariant),
+    # framed into the corpus slab (storage/slab.py) — or a per-feed
+    # `.cols2` file when the slab layout is disabled
+    ckpt = tpl.checkpoint_bytes(pk)
+    if slab is not None:
+        from ..storage.slab import KIND_IMAGE
+
+        slab.append(KIND_IMAGE, pk, ckpt)
+    else:
+        with open(os.path.join(d, pk + ".cols2"), "wb") as fh:
+            fh.write(ckpt)
 
 
 def make_corpus(
@@ -154,18 +163,28 @@ def make_corpus(
 
     pairs = [keymod.create() for _ in range(n_docs)]
 
-    with ThreadPoolExecutor(max_workers=threads) as pool:
-        list(
-            pool.map(
-                lambda i: _write_doc(
-                    feeds_root,
-                    pairs[i],
-                    templates[i % len(templates)],
-                    sign,
-                ),
-                range(n_docs),
+    slab = None
+    if os.environ.get("HM_SLAB", "1") != "0":
+        from ..storage.slab import CorpusSlab
+
+        slab = CorpusSlab(os.path.join(feeds_root, "cols.slab"))
+    try:
+        with ThreadPoolExecutor(max_workers=threads) as pool:
+            list(
+                pool.map(
+                    lambda i: _write_doc(
+                        feeds_root,
+                        pairs[i],
+                        templates[i % len(templates)],
+                        sign,
+                        slab,
+                    ),
+                    range(n_docs),
+                )
             )
-        )
+    finally:
+        if slab is not None:
+            slab.close()
 
     db = SqlDatabase(os.path.join(path, "repo.db"))
     repo_pair = keymod.create()

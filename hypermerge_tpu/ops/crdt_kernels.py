@@ -302,60 +302,195 @@ def materialize_device(
     )
 
 
-class SummaryOut(NamedTuple):
-    """Compact device-side summary of materialized state — what the bulk
-    path transfers to host. Over the tunneled single-chip link (~10MB/s)
-    this is the difference between ~1s and ~20s for a 4096x1024 batch:
-    masks travel bit-packed, element order as int16 when it fits.
-    """
+# ---------------------------------------------------------------------------
+# summary wire: ONE fused uint8 buffer per slab
+#
+# The materialization barrier's transfer used to be six leaves per slab
+# (bit-packed masks, an int16 elem_order, two count vectors, the clock).
+# Bytes — not dispatches — bound the tunneled link, and elem_order was
+# ~85% of them at 16 bits per entry for values that need ceil(log2 N).
+# The wire packs everything into a single [D, W] uint8 buffer per slab:
+# masks bit-packed, elem_order at exactly `order_bits` bits per entry,
+# counts at int16 when N allows, and the clock section omitted entirely
+# on lean runs (the bulk loader holds authoritative host clocks). For
+# the 10k x 1k corpus this is ~1540 bytes/doc vs ~2330 — and one
+# transfer to start asynchronously instead of six.
 
-    map_winner_bits: jax.Array  # uint8 [D, ceil(N/8)], little bit order
-    elem_live_bits: jax.Array  # uint8 [D, ceil(N/8)]
-    elem_order: jax.Array  # int16/int32 [D, N]: row idx by RGA order
-    n_live_elems: jax.Array  # int32 [D]
-    n_map_entries: jax.Array  # int32 [D]
-    clock: jax.Array  # int32 [D, A]
+
+def summary_wire_spec(N: int, A: int, lean: bool) -> Dict[str, int]:
+    """Byte layout of the [D, W] summary wire buffer."""
+    mask_bytes = (N + 7) // 8
+    order_bits = max(1, (N - 1).bit_length())
+    order_bytes = (N * order_bits + 7) // 8
+    count_bytes = 2 if N < 2**15 else 4
+    clock_bytes = 0 if lean else 4 * A
+    return {
+        "mask_bytes": mask_bytes,
+        "order_bits": order_bits,
+        "order_bytes": order_bytes,
+        "count_bytes": count_bytes,
+        "clock_bytes": clock_bytes,
+        "total": 2 * mask_bytes + order_bytes + 2 * count_bytes
+        + clock_bytes,
+    }
 
 
 def _pack_bits(mask: jax.Array) -> jax.Array:
-    """[D, N] bool -> [D, ceil(N/8)] uint8, little bit order (numpy
+    """[D, N] bool/0-1 -> [D, ceil(N/8)] uint8, little bit order (numpy
     np.unpackbits(..., bitorder='little') inverts it exactly)."""
     D, N = mask.shape
     pad = (-N) % 8
-    m = jnp.pad(mask, ((0, 0), (0, pad))).reshape(D, -1, 8)
+    m = jnp.pad(mask.astype(jnp.uint8), ((0, 0), (0, pad))).reshape(
+        D, -1, 8
+    )
     weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
-    return (m.astype(jnp.uint8) * weights).sum(-1).astype(jnp.uint8)
+    return (m * weights).sum(-1).astype(jnp.uint8)
 
 
-def _summarize(out: MaterializeOut, N: int) -> SummaryOut:
+def _pack_uint(vals: jax.Array, bits: int) -> jax.Array:
+    """[D, N] ints in [0, 2^bits) -> [D, ceil(N*bits/8)] uint8: each
+    value at exactly `bits` bits, little bit order throughout."""
+    D, N = vals.shape
+    shifts = jnp.arange(bits, dtype=jnp.int32)
+    bitmat = (
+        (vals.astype(jnp.int32)[..., None] >> shifts) & 1
+    ).reshape(D, N * bits)
+    return _pack_bits(bitmat)
+
+
+def _le_bytes(x: jax.Array, nbytes: int) -> jax.Array:
+    """[D, k] ints -> [D, k*nbytes] uint8, little-endian per element
+    (portable across backends — no bitcast)."""
+    xi = x.astype(jnp.int32)
+    parts = [
+        ((xi >> (8 * i)) & 0xFF).astype(jnp.uint8) for i in range(nbytes)
+    ]
+    return jnp.stack(parts, axis=-1).reshape(x.shape[0], -1)
+
+
+def _summarize_wire(
+    out: MaterializeOut, N: int, A: int, lean: bool
+) -> jax.Array:
+    spec = summary_wire_spec(N, A, lean)
     order_key = jnp.where(
         out.elem_live, -out.rank, jnp.iinfo(jnp.int32).max
     )
-    elem_order = jnp.argsort(order_key, axis=1).astype(
-        jnp.int16 if N < 2**15 else jnp.int32
+    elem_order = jnp.argsort(order_key, axis=1).astype(jnp.int32)
+    cb = spec["count_bytes"]
+    parts = [
+        _pack_bits(out.map_winner),
+        _pack_bits(out.elem_live),
+        _pack_uint(elem_order, spec["order_bits"]),
+        _le_bytes(out.elem_live.sum(axis=1, dtype=jnp.int32)[:, None], cb),
+        _le_bytes(out.map_winner.sum(axis=1, dtype=jnp.int32)[:, None], cb),
+    ]
+    if not lean:
+        parts.append(_le_bytes(out.clock, 4))
+    return jnp.concatenate(parts, axis=1)
+
+
+def _unpack_uint(packed: "Any", N: int, bits: int) -> "Any":
+    """Host-side inverse of _pack_uint: [D, OB] uint8 -> [D, N] int64.
+    Vectorized byte gathers — no np.unpackbits blowup (that would
+    materialize `bits` bytes per value)."""
+    import numpy as np
+
+    D = packed.shape[0]
+    idx = np.arange(N, dtype=np.int64) * bits
+    lo = (idx >> 3).astype(np.int64)
+    sh = (idx & 7).astype(np.int64)
+    pk = np.concatenate([packed, np.zeros((D, 4), np.uint8)], axis=1)
+    wide = bits > 17  # sh + bits can exceed the 3-byte window
+    acct = np.int64 if wide else np.int32
+    acc = pk[:, lo].astype(acct)
+    acc |= pk[:, lo + 1].astype(acct) << 8
+    acc |= pk[:, lo + 2].astype(acct) << 16
+    if wide:
+        acc |= pk[:, lo + 3].astype(acct) << 24
+    return ((acc >> sh.astype(acct)) & ((1 << bits) - 1)).astype(np.int64)
+
+
+def unpack_bits_le(packed, N: int):
+    """Host-side inverse of _pack_bits: [D, ceil(N/8)] uint8 -> [D, N]
+    bool. The single unpack twin for BOTH fetched wires and memo-served
+    summary rows — bit order/padding changes happen here and in
+    _pack_bits only."""
+    import numpy as np
+
+    return np.unpackbits(
+        np.ascontiguousarray(packed), axis=1, bitorder="little"
+    )[:, :N].astype(bool)
+
+
+def parse_summary_wire(wire, N: int, A: int, lean: bool):
+    """Host decode of one slab's fused summary buffer -> the columnar
+    summary dict (same keys/values as ops.materialize.decode_columnar;
+    the clock comes back zeros on lean wires — the caller overlays its
+    authoritative host clocks)."""
+    import numpy as np
+
+    spec = summary_wire_spec(N, A, lean)
+    wire = np.asarray(wire)
+    D = wire.shape[0]
+    assert wire.shape[1] == spec["total"], (wire.shape, spec)
+    mb = spec["mask_bytes"]
+
+    def bits(seg):
+        return unpack_bits_le(seg, N)
+
+    o = 2 * mb
+    ob = spec["order_bytes"]
+    elem_order = _unpack_uint(
+        np.ascontiguousarray(wire[:, o : o + ob]), N, spec["order_bits"]
     )
-    return SummaryOut(
-        map_winner_bits=_pack_bits(out.map_winner),
-        elem_live_bits=_pack_bits(out.elem_live),
-        elem_order=elem_order,
-        n_live_elems=out.elem_live.sum(axis=1, dtype=jnp.int32),
-        n_map_entries=out.map_winner.sum(axis=1, dtype=jnp.int32),
-        clock=out.clock,
+    o += ob
+    cb = spec["count_bytes"]
+    cdt = "<i2" if cb == 2 else "<i4"
+    n_live = (
+        np.ascontiguousarray(wire[:, o : o + cb])
+        .view(cdt)
+        .ravel()
+        .astype(np.int64)
     )
+    o += cb
+    n_map = (
+        np.ascontiguousarray(wire[:, o : o + cb])
+        .view(cdt)
+        .ravel()
+        .astype(np.int64)
+    )
+    o += cb
+    if lean:
+        clock = np.zeros((D, A), np.int32)
+    else:
+        clock = (
+            np.ascontiguousarray(wire[:, o : o + 4 * A])
+            .view("<i4")
+            .reshape(D, A)
+        )
+    return {
+        "map_winner": bits(wire[:, 0:mb]),
+        "elem_live": bits(wire[:, mb : 2 * mb]),
+        "elem_order": elem_order,
+        "n_live_elems": n_live,
+        "n_map_entries": n_map,
+        "clock": clock,
+    }
 
 
 @partial(jax.jit, static_argnames=("A", "K"))
 def materialize_summary_device(
     flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
     doc_actors, A: int, K: int,
-) -> SummaryOut:
+) -> jax.Array:
     """Kernel + on-device summarization in ONE dispatch: the full per-row
-    lanes (visible/rank/winner masks) never leave the device."""
+    lanes (visible/rank/winner masks) never leave the device; the return
+    is the fused summary wire buffer."""
     out = batched_kernel(A, K)(
         flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
         doc_actors,
     )
-    return _summarize(out, flags.shape[1])
+    return _summarize_wire(out, flags.shape[1], A, lean=False)
 
 
 @partial(jax.jit, static_argnames=("A", "K"))
@@ -363,15 +498,15 @@ def materialize_full_device(
     flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
     doc_actors, A: int, K: int,
 ):
-    """One dispatch -> (MaterializeOut, SummaryOut). The bulk loader uses
-    this: summaries transfer compactly for the materialization barrier,
-    while the full lanes stay device-resident for lazy per-doc patch
-    decode (DecodedBatch.doc_view)."""
+    """One dispatch -> (MaterializeOut, summary wire). The bulk loader
+    uses this: the fused summary buffer transfers compactly for the
+    materialization barrier, while the full lanes stay device-resident
+    for lazy per-doc patch decode (DecodedBatch.doc_view)."""
     out = batched_kernel(A, K)(
         flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt,
         doc_actors,
     )
-    return out, _summarize(out, flags.shape[1])
+    return out, _summarize_wire(out, flags.shape[1], A, lean=False)
 
 
 @partial(jax.jit, static_argnames=("A", "K"))
@@ -380,18 +515,18 @@ def materialize_full_lean_device(
     A: int, K: int,
 ):
     """materialize_full_device minus the seq and value wires (~4 bytes/op
-    on a link where every byte is wall-clock). Correct ONLY when the
-    batch has no INC ops (value feeds counter accumulation) and the
-    caller supplies clocks host-side (seq feeds only the clock lane —
-    the bulk loader's clocks come from the sidecar metadata and are the
-    more authoritative value anyway). inc_total and clock lanes come
-    back as zeros."""
+    on a link where every byte is wall-clock) AND minus the summary's
+    clock section. Correct ONLY when the batch has no INC ops (value
+    feeds counter accumulation) and the caller supplies clocks host-side
+    (seq feeds only the clock lane — the bulk loader's clocks come from
+    the sidecar metadata and are the more authoritative value anyway).
+    inc_total and clock lanes come back as zeros."""
     zeros = jnp.zeros_like(ctr)
     out = batched_kernel(A, K)(
         flags, slot, ctr, zeros, obj, key, ref, zeros, psrc, ptgt,
         doc_actors,
     )
-    return out, _summarize(out, flags.shape[1])
+    return out, _summarize_wire(out, flags.shape[1], A, lean=True)
 
 
 def ensure_doc_actors(batch: ColumnarBatch):
@@ -534,8 +669,9 @@ def _device_args(batch: ColumnarBatch, lean: bool = False):
     return args, A, K
 
 
-def run_batch_summary(batch: ColumnarBatch) -> SummaryOut:
-    """Host entry for the bulk path: pack numpy -> fused kernel+summary."""
+def run_batch_summary(batch: ColumnarBatch) -> jax.Array:
+    """Host entry for the bulk path: pack numpy -> fused kernel+summary
+    wire buffer (decode with parse_summary_wire)."""
     args, A, K = _device_args(batch)
     return materialize_summary_device(*args, A=A, K=K)
 
@@ -547,7 +683,8 @@ def run_batch(batch: ColumnarBatch) -> MaterializeOut:
 
 
 def run_batch_full(batch: ColumnarBatch, lean: bool = False):
-    """Host entry -> (MaterializeOut, SummaryOut) in one dispatch.
+    """Host entry -> (MaterializeOut, fused summary wire buffer) in one
+    dispatch (decode the wire with parse_summary_wire).
 
     `lean=True` (callers that hold authoritative host clocks and verified
     the batch carries no INC ops) skips the seq/value wires entirely."""

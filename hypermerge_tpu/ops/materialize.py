@@ -338,53 +338,53 @@ def decode_columnar(dec: DecodedBatch) -> Dict[str, np.ndarray]:
     }
 
 
-def fetch_summary(s, N: int) -> Dict[str, np.ndarray]:
-    """Transfer a device SummaryOut to host numpy (bit unpack applied)."""
+def fetch_summary(wire, batch: ColumnarBatch, lean: bool = False):
+    """Transfer + decode one slab's fused summary wire buffer (see
+    ops/crdt_kernels.py summary_wire_spec for the byte layout)."""
+    from .crdt_kernels import bucket_doc_actors, parse_summary_wire
 
-    def unpack(bits: np.ndarray) -> np.ndarray:
-        return np.unpackbits(bits, axis=1, bitorder="little")[:, :N].astype(
-            bool
-        )
-
-    return {
-        "map_winner": unpack(np.asarray(s.map_winner_bits)),
-        "elem_live": unpack(np.asarray(s.elem_live_bits)),
-        "elem_order": np.asarray(s.elem_order).astype(np.int64),
-        "n_live_elems": np.asarray(s.n_live_elems).astype(np.int64),
-        "n_map_entries": np.asarray(s.n_map_entries).astype(np.int64),
-        "clock": np.asarray(s.clock),
-    }
+    _da, A, _K = bucket_doc_actors(batch)
+    return parse_summary_wire(
+        np.asarray(wire), batch.n_rows, A, lean
+    )
 
 
 def summarize_columnar(batch: ColumnarBatch) -> Dict[str, np.ndarray]:
-    """Bulk path: fused kernel+summary on device, compact transfer, bit
-    unpack on host. Same keys/values as decode_columnar(run_batch(...))."""
+    """Bulk path: fused kernel+summary on device, ONE compact transfer,
+    decode on host. Same keys/values as decode_columnar(run_batch(...))."""
     from .crdt_kernels import run_batch_summary
 
-    return fetch_summary(run_batch_summary(batch), batch.n_rows)
+    return fetch_summary(run_batch_summary(batch), batch)
 
 
 class BulkSummaries:
     """Host-side summaries of a bulk load's slabs — the product of the
     materialization barrier (RepoBackend.fetch_bulk_summaries). Slab
     arrays stay columnar (zero-copy for bulk consumers); `doc(id)` decodes
-    one doc's counts + clock on demand."""
+    one doc's counts + clock on demand.
 
-    def __init__(self, pending) -> None:
-        # pending: (doc_ids, batch, dec, device_summary_or_None) per slab
-        self.slabs: List[Tuple[List[str], ColumnarBatch, Dict]] = []
+    `memo_slabs` carries docs served from the backend's summary memo
+    (clean docs whose clocks did not move since their last fetch — no
+    pack, no dispatch, no transfer): (doc_ids, arrays, clock_dicts)
+    groups whose arrays follow the same columnar contract, with the
+    per-doc clock already decoded."""
+
+    def __init__(self, pending, memo_slabs=None) -> None:
+        # pending: (doc_ids, batch, dec, summary_wire_or_None, lean)
+        self.slabs: List[Tuple[List[str], Optional[ColumnarBatch], Dict]] = []
         self._where: Dict[str, Tuple[int, int]] = {}
-        for doc_ids, batch, dec, summary in pending:
+        for doc_ids, batch, dec, wire, lean in pending:
             arrays = (
                 decode_columnar(dec)
-                if summary is None  # host-kernel slab: no device refs
-                else fetch_summary(summary, batch.n_rows)
+                if wire is None  # host-kernel slab: no device refs
+                else fetch_summary(wire, batch, lean)
             )
             if dec.host_clocks is not None:
-                # lean slabs never transferred the seq wire, so the
-                # device clock lane is zeros: rebuild it from the
-                # authoritative host clocks so the columnar contract
-                # (arrays()['clock']) stays consistent with doc()
+                # lean slabs never transferred the seq wire (nor the
+                # wire's clock section), so the clock lane is zeros:
+                # rebuild it from the authoritative host clocks so the
+                # columnar contract (arrays()['clock']) stays consistent
+                # with doc()
                 from .crdt_kernels import ensure_doc_actors
 
                 da = ensure_doc_actors(batch)
@@ -399,12 +399,19 @@ class BulkSummaries:
                                 batch.actors[int(gid)], 0
                             )
                 arrays["clock"] = clock
-            self.slabs.append((doc_ids, batch, arrays))
-            # only small per-doc dicts are retained — the DecodedBatch
-            # (device lanes + column copies) must be releasable once
-            # docs drop their lazy snapshot closures
-            for j, d in enumerate(doc_ids):
-                self._where[d] = (len(self.slabs) - 1, j)
+            self._add_slab(doc_ids, batch, arrays)
+        for doc_ids, arrays, clock_dicts in memo_slabs or ():
+            arrays = dict(arrays)
+            arrays["clock_dicts"] = list(clock_dicts)
+            self._add_slab(doc_ids, None, arrays)
+
+    def _add_slab(self, doc_ids, batch, arrays) -> None:
+        # only small per-doc dicts are retained — the DecodedBatch
+        # (device lanes + column copies) must be releasable once docs
+        # drop their lazy snapshot closures
+        self.slabs.append((doc_ids, batch, arrays))
+        for j, d in enumerate(doc_ids):
+            self._where[d] = (len(self.slabs) - 1, j)
 
     @property
     def doc_ids(self) -> List[str]:
@@ -418,12 +425,16 @@ class BulkSummaries:
     def doc(self, doc_id: str) -> Dict[str, Any]:
         si, j = self._where[doc_id]
         doc_ids, batch, arrays = self.slabs[si]
+        if batch is None:  # memo-served group: clock pre-decoded
+            clock = dict(arrays["clock_dicts"][j])
+        else:
+            clock = _local_clock_dict(
+                batch, _doc_actors_row(batch, j), arrays["clock"][j]
+            )
         return {
             "elems": int(arrays["n_live_elems"][j]),
             "map_entries": int(arrays["n_map_entries"][j]),
-            "clock": _local_clock_dict(
-                batch, _doc_actors_row(batch, j), arrays["clock"][j]
-            ),
+            "clock": clock,
         }
 
 

@@ -92,7 +92,7 @@ def _warm(
         lean = not bool(np.any(batch.cols["action"] == int(Action.INC)))
         out, summary = run_batch_full(batch, lean=lean)
         # force compile completion (dispatch alone returns early)
-        np.asarray(summary.clock.ravel()[:1])
+        np.asarray(summary.ravel()[:1])
 
 
 def warmup_bulk(

@@ -99,29 +99,31 @@ def sharded_materialize(
     return _materialize_on_mesh(batch, mesh)[0]
 
 
-def sharded_full(batch: ColumnarBatch, mesh: Mesh):
-    """(MaterializeOut, SummaryOut) sharded over dp — the multi-chip twin
-    of ops.crdt_kernels.run_batch_full, and the dispatch the PRODUCT bulk
-    loader uses when a mesh is available (RepoBackend._load_slabs): full
-    lanes stay device-resident per shard for lazy patch decode, compact
-    summaries transfer for the materialization barrier. Per-doc compute
+def sharded_full(batch: ColumnarBatch, mesh: Mesh, lean: bool = False):
+    """(MaterializeOut, summary wire) sharded over dp — the multi-chip
+    twin of ops.crdt_kernels.run_batch_full, and the dispatch the PRODUCT
+    bulk loader uses when a mesh is available (RepoBackend._load_slabs):
+    full lanes stay device-resident per shard for lazy patch decode, the
+    fused summary buffer transfers for the materialization barrier (one
+    dp-sharded [D, W] uint8 leaf). `lean` drops the wire's clock section
+    — callers holding authoritative host clocks only. Per-doc compute
     has no cross-doc data flow, so XLA compiles this with zero
     collectives — linear scaling over dp."""
-    from ..ops.crdt_kernels import SummaryOut, _summarize, batched_kernel
+    from ..ops.crdt_kernels import _summarize_wire, batched_kernel
 
     args, A, K, _ = shard_batch(batch, mesh)
     sh = doc_sharding(mesh)
 
     def fn(*xs):
         out = batched_kernel(A, K)(*xs)
-        return out, _summarize(out, batch.n_rows)
+        return out, _summarize_wire(out, batch.n_rows, A, lean)
 
     jfn = jax.jit(
         fn,
         in_shardings=(sh,) * _N_ARGS,
         out_shardings=(
             MaterializeOut(*([sh] * len(MaterializeOut._fields))),
-            SummaryOut(*([sh] * len(SummaryOut._fields))),
+            sh,
         ),
     )
     with mesh:

@@ -117,6 +117,12 @@ class FeedColumns:
     # i+1) owns rows [row_ends[i], row_ends[i+1])
     row_ends: np.ndarray
     planes: Optional[Dict[str, np.ndarray]] = None
+    # (base_addr, offsets[len(PLANE_NAMES)] int64, dtype_codes uint8,
+    # keep_alive) when every plane is a slice of ONE raw checkpoint
+    # buffer: the native bulk pack derives all plane pointers from the
+    # base address instead of a per-plane __array_interface__ walk
+    # (which costs ~5us x 12 planes x 10k feeds on a cold open)
+    plane_meta: Optional[Tuple] = None
 
     @property
     def n_rows(self) -> int:
@@ -509,8 +515,10 @@ def pack_v3_checkpoint(
 
 
 def parse_v3_checkpoint(raw: bytes):
-    """(planes, preds, row_ends, flags, tables_lines, end_offset) or
-    None when `raw` does not start with a complete v3 block."""
+    """(planes, preds, row_ends, flags, tables_lines, end_offset,
+    plane_meta) or None when `raw` does not start with a complete v3
+    block. plane_meta is the FeedColumns.plane_meta tuple (pointer table
+    for the native bulk pack)."""
     if not raw.startswith(_V3_MAGIC):
         return None
     pos = len(_V3_MAGIC)
@@ -519,7 +527,11 @@ def parse_v3_checkpoint(raw: bytes):
     n_rows, n_changes, n_preds, t_len = _V3_HDR.unpack_from(raw, pos)
     pos += _V3_HDR.size
     planes: Dict[str, np.ndarray] = {}
-    for name in PLANE_NAMES:
+    base = np.frombuffer(raw, np.uint8)
+    base_addr = base.__array_interface__["data"][0]
+    plane_offs = np.empty(len(PLANE_NAMES), np.int64)
+    plane_dts = np.empty(len(PLANE_NAMES), np.uint8)
+    for pi, name in enumerate(PLANE_NAMES):
         if pos + 1 > len(raw):
             return None
         code = raw[pos]
@@ -531,7 +543,10 @@ def parse_v3_checkpoint(raw: bytes):
         if pos + nbytes > len(raw):
             return None
         planes[name] = np.frombuffer(raw, dt, count=n_rows, offset=pos)
+        plane_offs[pi] = pos
+        plane_dts[pi] = code
         pos += nbytes
+    plane_meta = (base_addr, plane_offs, plane_dts, base)
     need = n_changes * 8 + n_changes + n_preds * 4 * PRED_FIELDS + t_len
     if pos + need > len(raw):
         return None
@@ -549,7 +564,7 @@ def parse_v3_checkpoint(raw: bytes):
         else []
     )
     pos += t_len
-    return planes, preds, row_ends, flags, tables, pos
+    return planes, preds, row_ends, flags, tables, pos, plane_meta
 
 
 def pack_v2_record(
@@ -617,19 +632,23 @@ class FileColumnStorageV2:
 
     def load_v3(self):
         """(base_planes|None, tail_rows, preds, tables, commits,
-        n_tail_records): the checkpoint (when present) plus the v2 tail
-        after it. Base commits synthesize [row_end, 0, 0, flag] rows —
-        only columns 0 and 3 feed FeedColumns."""
+        n_tail_records, plane_meta|None): the checkpoint (when present)
+        plus the v2 tail after it. Base commits synthesize
+        [row_end, 0, 0, flag] rows — only columns 0 and 3 feed
+        FeedColumns."""
         try:
             with open(self.path, "rb") as fh:
                 raw = fh.read()
         except OSError:
             raw = b""
+        return self._load_v3_bytes(raw)  # _load_v2 records the valid end
+
+    def _load_v3_bytes(self, raw: bytes):
         ck = parse_v3_checkpoint(raw)
         if ck is None:
             rows, preds, tables, commits = self._load_v2(raw, 0)
-            return None, rows, preds, tables, commits, len(commits)
-        planes, preds_ck, row_ends, flags, tables_ck, off = ck
+            return None, rows, preds, tables, commits, len(commits), None
+        planes, preds_ck, row_ends, flags, tables_ck, off, meta = ck
         t_rows, t_preds, t_tables, t_commits = self._load_v2(raw, off)
         n_base_rows = int(row_ends[-1]) if len(row_ends) else 0
         commits = np.zeros(
@@ -653,13 +672,13 @@ class FileColumnStorageV2:
         )
         return (
             planes, t_rows, preds, tables_ck + t_tables, commits,
-            len(t_commits),
+            len(t_commits), meta,
         )
 
     def load(self):
         """Legacy whole-rows entry: delegates to load_v3 and widens any
         checkpoint planes into the dense row matrix."""
-        planes, t_rows, preds, tables, commits, _ = self.load_v3()
+        planes, t_rows, preds, tables, commits, _, _meta = self.load_v3()
         if planes is None:
             return t_rows, preds, tables, commits
         base = rows_from_planes(planes)
@@ -762,21 +781,110 @@ class FileColumnStorageV2:
         pass
 
 
+class SlabColumnStorage(FileColumnStorageV2):
+    """One feed's sidecar served from the corpus slab (storage/slab.py).
+
+    Byte format per feed is identical to the `.cols2` single file —
+    the slab just frames many of them in one file — so this subclass
+    only redirects the byte source: loads slice the slab's mmap,
+    commits append record segments, checkpoints append a fresh image.
+    A legacy `.cols2` file migrates lazily on first read: its bytes
+    become the feed's image segment and the file is deleted (sidecars
+    are derived data — a crash between the two at worst rebuilds from
+    blocks, the cache's normal recovery)."""
+
+    def __init__(
+        self, slab, name: str, legacy_v2: Optional[str] = None
+    ) -> None:
+        super().__init__(slab.path + "#" + name)  # diagnostic only
+        self._slab = slab
+        self._name = name
+        self._legacy_v2 = legacy_v2
+
+    def load_v3(self):
+        from .slab import KIND_IMAGE
+
+        raw = self._slab.image_bytes(self._name)
+        if not raw and not self._slab.has(self._name):
+            lp = self._legacy_v2
+            if lp is not None and os.path.exists(lp):
+                with open(lp, "rb") as fh:
+                    raw = fh.read()
+                self._slab.append(KIND_IMAGE, self._name, raw)
+                try:
+                    os.remove(lp)
+                except OSError:
+                    pass
+        return self._load_v3_bytes(raw)
+
+    def commit_change(self, rows, preds, table_lines, flag) -> None:
+        from .slab import KIND_RECORD
+
+        self._slab.append(
+            KIND_RECORD,
+            self._name,
+            pack_v2_record(rows, preds, table_lines, flag),
+        )
+
+    def write_checkpoint(
+        self, planes, preds, row_ends, flags, tables_bytes
+    ) -> None:
+        from .slab import KIND_IMAGE
+
+        self._slab.append(
+            KIND_IMAGE,
+            self._name,
+            pack_v3_checkpoint(planes, preds, row_ends, flags, tables_bytes),
+        )
+
+    def reset(self) -> None:
+        from .slab import KIND_TOMBSTONE
+
+        if self._slab.feed_live(self._name):
+            self._slab.append(KIND_TOMBSTONE, self._name, b"")
+        lp = self._legacy_v2
+        if lp is not None and os.path.exists(lp):
+            os.remove(lp)
+        self._counts = None
+
+    def destroy(self) -> None:
+        self.reset()
+
+    def close(self) -> None:  # the slab is owned/closed by the repo
+        pass
+
+
 def memory_column_storage_fn(_name: str) -> MemoryColumnStorage:
     return MemoryColumnStorage()
 
 
 def file_column_storage_fn(root: str):
-    """New sidecars use the single-file v2 layout; directories written by
-    older versions keep loading through the 4-file reader."""
+    """Sidecars live in the corpus slab (storage/slab.py): one file, one
+    open, sequential reads for a whole cold start. Per-feed `.cols2`
+    files written by older versions migrate into the slab lazily on
+    first read; directories written by the oldest 4-file layout keep
+    loading through their reader. HM_SLAB=0 restores the per-feed
+    single-file layout. The returned fn carries the slab handle as
+    `fn.slab` (the backend compacts + closes it on shutdown)."""
+    use_slab = os.environ.get("HM_SLAB", "1") != "0"
+    slab = None
+    if use_slab:
+        from .slab import CorpusSlab
+
+        slab = CorpusSlab(os.path.join(root, "cols.slab"))
 
     def fn(name: str):
         legacy = os.path.join(root, name[:2], name + ".cols")
         v2 = os.path.join(root, name[:2], name + ".cols2")
+        if slab is not None and slab.has(name):
+            return SlabColumnStorage(slab, name, legacy_v2=v2)
         if os.path.isdir(legacy) and not os.path.exists(v2):
             return FileColumnStorage(legacy)
-        return FileColumnStorageV2(v2)
+        if slab is None:
+            return FileColumnStorageV2(v2)
+        return SlabColumnStorage(slab, name, legacy_v2=v2)
 
+    fn.slab = slab
     return fn
 
 
@@ -831,11 +939,13 @@ class FeedColumnCache:
         self._bigints = _Interner()
         self._pending_tables = []
         self._base_planes: Optional[Dict[str, np.ndarray]] = None
+        self._base_meta = None
         n_tail = 0
         lv3 = getattr(self._storage, "load_v3", None)
         if lv3 is not None:
             (
                 self._base_planes, rows, preds, tables, commits, n_tail,
+                self._base_meta,
             ) = lv3()
         else:
             rows, preds, tables, commits = self._storage.load()
@@ -1019,6 +1129,7 @@ class FeedColumnCache:
             self._loaded = True  # reset state IS the loaded-fresh state
             self._storage.reset()
             self._base_planes = None
+            self._base_meta = None
             self._base_rows = 0
             self._actors = _Interner()
             self._keys = _Interner()
@@ -1041,9 +1152,11 @@ class FeedColumnCache:
             if self._cached is not None:
                 return self._cached
             planes = None
+            meta = None
             if self._base_planes is not None:
                 if not self._row_chunks:
                     planes = self._base_planes  # pure checkpoint load
+                    meta = self._base_meta
                 else:
                     # live appends landed after the checkpoint: fold the
                     # planes into dense rows once and continue row-wise
@@ -1051,6 +1164,7 @@ class FeedColumnCache:
                         0, rows_from_planes(self._base_planes)
                     )
                     self._base_planes = None
+                    self._base_meta = None
                     self._base_rows = 0
             rows = (
                 self._row_chunks[0]
@@ -1104,6 +1218,7 @@ class FeedColumnCache:
                 ok_prefix_len=ok_prefix,
                 row_ends=row_ends,
                 planes=planes,
+                plane_meta=meta,
             )
             return self._cached
 

@@ -73,9 +73,19 @@ class FileFeedStorage:
         self._sizes: List[int] = []
         self._end = 0
         self._count: Optional[int] = None  # known count, offsets may lag
-        self._scanned = not os.path.exists(path)
-        if self._scanned:
-            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._scanned = False
+        # the does-the-log-exist stat is deferred to first use: a bulk
+        # cold open constructs thousands of these and metadata syscalls
+        # are a measurable slice of its serial host time
+        self._init_checked = False
+
+    def _check_init(self) -> None:
+        if self._init_checked:
+            return
+        self._init_checked = True
+        if not os.path.exists(self.path):
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._scanned = True
             self._count = 0
 
     def _len_path(self) -> str:
@@ -106,10 +116,15 @@ class FileFeedStorage:
         if self._count is not None:
             return
         if self._try_count_shortcut():
+            # a matching .len proves the log exists: the healthy-feed
+            # fast path costs one open + one stat, nothing else
+            self._init_checked = True
             return
+        self._check_init()
         self._ensure_scan()
 
     def _ensure_scan(self) -> None:
+        self._check_init()
         if self._scanned:
             return
         self._scanned = True
@@ -332,16 +347,37 @@ class Feed:
             cb(index, index + 1)
         return index
 
-    def put_sparse(self, index: int, data: bytes) -> None:
+    def put_sparse(self, index: int, data: bytes) -> bool:
         """Store an out-of-order block the caller has ALREADY verified
-        (inclusion proof against a signed root — net/replication.py)."""
+        (inclusion proof against a signed root — net/replication.py).
+
+        The buffer is bounded (HM_SPARSE_CAP entries): when full, the
+        entry FURTHEST beyond the contiguous head is evicted — blocks
+        near the head are about to be absorbed by backfill, while far
+        ones can be re-fetched; an incoming block beyond everything
+        buffered is simply dropped. A hostile or runaway peer can
+        therefore never grow this map without bound.
+
+        Returns True when the block is retrievable afterwards (stored,
+        or already covered by the contiguous log) and False when the cap
+        dropped it — the replication layer keeps a dropped index in its
+        outstanding-request set so a re-served copy is not mistaken for
+        an unsolicited push."""
         with self._lock:
             if index < len(self._storage):
-                return  # contiguous log already holds it
+                return True  # contiguous log already holds it
+            if index not in self._sparse:
+                cap = int(os.environ.get("HM_SPARSE_CAP", "1024"))
+                if len(self._sparse) >= cap:
+                    worst = max(self._sparse)
+                    if index >= worst:
+                        return False  # incoming is the furthest: drop
+                    del self._sparse[worst]
             self._sparse[index] = data
             listeners = list(self._sparse_listeners)
         for cb in listeners:
             cb(index, data)
+        return True
 
     def _prune_sparse_locked(self) -> None:
         # caller holds the lock; entries the contiguous head passed are

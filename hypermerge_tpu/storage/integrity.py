@@ -178,6 +178,12 @@ class FeedIntegrity:
         self._records: Optional[List[Tuple[int, bytes, bytes]]] = None
         self._peaks: Optional[Peaks] = None
         self._leaves: List[bytes] = []
+        # per-length interior merkle levels for the proof server
+        # (build_proof_ctx): the tree at a given length is immutable in
+        # an append-only log, so entries stay valid forever — the tiny
+        # LRU just bounds memory. Serving a repeated RequestRange costs
+        # O(range x log n) hash LOOKUPS, zero hash computations.
+        self._proof_cache: Dict[int, tuple] = {}
         # appends this session not yet covered by a stored record
         # (periodic signing skipped them) — Feed.close/seal signs then
         self.unsigned_tail = False
@@ -214,12 +220,36 @@ class FeedIntegrity:
 
     def _ensure_leaves(self, feed, upto: int) -> List[bytes]:
         """Leaf hashes for feed blocks [0, upto) — cached, extended from
-        the block log as needed."""
-        with self._lock:
-            if len(self._leaves) < upto:
-                blocks = feed.get_batch(len(self._leaves), upto)
-                self._leaves.extend(crypto.leaf_hash(b) for b in blocks)
-            return self._leaves[:upto]
+        the block log as needed.
+
+        Lock order: the documented order is feed lock BEFORE integrity
+        lock (Feed.append -> sign_append). Callers that hold neither
+        (range_proofs serving a RequestRange with a stale leaf cache)
+        must not acquire them inverted, so the block snapshot
+        (feed.get_batch, feed lock) happens OUTSIDE the integrity lock;
+        the extension then re-checks under the lock — leaves are a pure
+        function of the blocks, so a concurrent extension that won the
+        race simply means fewer entries left for us to append."""
+        while True:
+            with self._lock:
+                have = len(self._leaves)
+                if have >= upto:
+                    return self._leaves[:upto]
+            blocks = feed.get_batch(have, upto)  # feed lock only
+            hashes = [crypto.leaf_hash(b) for b in blocks]
+            with self._lock:
+                cur = len(self._leaves)
+                if cur >= upto:
+                    return self._leaves[:upto]
+                if cur >= have:
+                    # a concurrent extension may have won part of the
+                    # race; leaves are a pure function of the blocks, so
+                    # the overlap is identical and we append the rest
+                    self._leaves.extend(hashes[cur - have :])
+                    return self._leaves[:upto]
+                # cur < have: the cache was RESET (destroy) between the
+                # snapshot and the re-lock — our hashes are misaligned;
+                # retry from the fresh state
 
     def _ensure_peaks(self, feed, upto: int) -> Peaks:
         with self._lock:
@@ -358,10 +388,29 @@ class FeedIntegrity:
             if rec is None or rec[0] < end:
                 return None
         length, _root, sig = rec
-        leaves = self._ensure_leaves(feed, length)
+        ctx = self._proof_ctx(feed, length)
         blocks = feed.get_batch(start, end)
-        proofs = range_inclusion_proofs(leaves, start, end, length)
+        proofs = proofs_from_ctx(ctx, start, end)
         return (length, sig, list(zip(blocks, proofs)))
+
+    def _proof_ctx(self, feed, length: int):
+        """The forest levels at `length`, cached. First build is the
+        O(length) hashing pass; every later range served against the
+        same signed record is pure lookup (the pre-cache server re-built
+        the whole level set per request: O(range x length))."""
+        with self._lock:
+            ctx = self._proof_cache.get(length)
+            if ctx is not None:
+                return ctx
+        # leaves snapshot outside the integrity lock (same lock-order
+        # rule as _ensure_leaves: never integrity -> feed)
+        leaves = self._ensure_leaves(feed, length)
+        ctx = build_proof_ctx(leaves, length)
+        with self._lock:
+            self._proof_cache[length] = ctx
+            while len(self._proof_cache) > 4:
+                self._proof_cache.pop(next(iter(self._proof_cache)))
+        return ctx
 
     # -- disk audit ---------------------------------------------------------
 
@@ -372,6 +421,7 @@ class FeedIntegrity:
             self._records = []
             self._peaks = None
             self._leaves = []
+            self._proof_cache = {}
 
     def audit(self, feed) -> bool:
         """Re-hash the entire block log against EVERY stored record —
@@ -434,18 +484,12 @@ def _peak_levels(leaves: List[bytes]) -> List[List[bytes]]:
     return levels
 
 
-def range_inclusion_proofs(
-    leaves: List[bytes], start: int, end: int, length: int
-) -> List[List[bytes]]:
-    """Merkle inclusion proofs for leaves [start, end) against the
-    promote-odd root at `length` (hypercore's sparse-download
-    verification model: a peer verifies blocks against a signed root
-    without holding the prefix). Each proof = the sibling path inside
-    the leaf's peak subtree (bottom-up), then every OTHER peak root in
-    forest order — positions derive client-side from (index, length),
-    so a proof is just hashes, ≤ 2·log2(length) of them. The tree
-    levels are built ONCE for the whole range: O(length) hashing total,
-    not O(range × length)."""
+def build_proof_ctx(leaves: List[bytes], length: int):
+    """(sizes, offs, levels, roots): every interior level of the
+    promote-odd forest at `length` — the one O(length) hashing pass the
+    proof server needs; serving any range afterwards is pure lookup.
+    Cached per length on FeedIntegrity (append-only logs never mutate
+    the tree at a given length)."""
     sizes = _peak_sizes(length)
     offs: List[int] = []
     levels: List[List[List[bytes]]] = []
@@ -457,6 +501,13 @@ def range_inclusion_proofs(
         levels.append(lv)
         roots.append(lv[-1][0])
         o += s
+    return sizes, offs, levels, roots
+
+
+def proofs_from_ctx(ctx, start: int, end: int) -> List[List[bytes]]:
+    """Proofs for leaves [start, end) from a built forest context:
+    O((end - start) x log(length)) hash lookups, zero hashing."""
+    sizes, offs, levels, roots = ctx
     out: List[List[bytes]] = []
     for index in range(start, end):
         j = 0
@@ -470,6 +521,19 @@ def range_inclusion_proofs(
         proof.extend(roots[q] for q in range(len(sizes)) if q != j)
         out.append(proof)
     return out
+
+
+def range_inclusion_proofs(
+    leaves: List[bytes], start: int, end: int, length: int
+) -> List[List[bytes]]:
+    """Merkle inclusion proofs for leaves [start, end) against the
+    promote-odd root at `length` (hypercore's sparse-download
+    verification model: a peer verifies blocks against a signed root
+    without holding the prefix). Each proof = the sibling path inside
+    the leaf's peak subtree (bottom-up), then every OTHER peak root in
+    forest order — positions derive client-side from (index, length),
+    so a proof is just hashes, ≤ 2·log2(length) of them."""
+    return proofs_from_ctx(build_proof_ctx(leaves, length), start, end)
 
 
 def inclusion_proof(

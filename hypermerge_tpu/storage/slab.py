@@ -1,0 +1,380 @@
+"""Corpus slab — every feed's columnar sidecar in ONE append-only file.
+
+The per-feed single-file sidecar (storage/colcache.py FileColumnStorageV2)
+made each sidecar one open+read — but a 10k-doc cold open still paid ~10k
+opens plus the directory-walk stats to find them, about 2s of the
+cold-open wall clock (BENCH_r05 t_io). The slab collapses all of that to
+O(1) opens and large sequential reads: one file of framed segments plus a
+tiny extent index, mmap'd once and sliced per feed.
+
+Layout (`feeds/cols.slab`):
+
+    header   b"HMSB" <u32 version=1>
+    segment  <u8 kind> <u16 name_len> name <u64 payload_len> payload
+
+kinds:
+    1  image     the feed's full sidecar image in FileColumnStorageV2
+                 byte format (v3 checkpoint blob, possibly followed by
+                 framed v2 records). Supersedes every earlier segment of
+                 the feed (written by checkpoint/compaction, and by the
+                 lazy migration of a legacy `.cols2` file on first read).
+    2  record    one framed v2 record appended after the feed's image
+                 (live writer path, storage/colcache.py commit_change).
+    3  tombstone the feed was reset/destroyed; earlier segments are dead.
+
+Index (`feeds/cols.slab.idx`): one entry per segment —
+    <u8 kind> <u16 name_len> name <u64 payload_off> <u64 payload_len>
+so open() reads the small index instead of scanning the slab. The index
+is advisory: a torn/missing/short index rebuilds (or repairs forward)
+by scanning slab segment headers; a torn slab tail — a segment whose
+declared payload runs past EOF — is ignored and overwritten by the next
+append. Crash model matches the sidecars it replaces: the columnar cache
+is derived data, blocks remain the source of truth.
+
+Superseded bytes (old images, tombstoned feeds) are reclaimed by
+`compact()`, which `close()` runs automatically when more than
+HM_SLAB_SLACK (default 25%) of the file is dead — tmp + atomic rename,
+so a crash mid-compaction leaves either the old file or the new one.
+"""
+
+from __future__ import annotations
+
+import io
+import mmap
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_MAGIC = b"HMSB"
+_VERSION = 1
+_HDR = struct.Struct("<4sI")
+_SEG = struct.Struct("<BH")  # kind, name_len  (then name, then <Q len)
+_LEN = struct.Struct("<Q")
+
+KIND_IMAGE = 1
+KIND_RECORD = 2
+KIND_TOMBSTONE = 3
+
+
+def _slack_fraction() -> float:
+    return float(os.environ.get("HM_SLAB_SLACK", "0.25"))
+
+
+class CorpusSlab:
+    """One repo's sidecar slab: extent index + append/read/compact."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.idx_path = path + ".idx"
+        self._lock = threading.RLock()
+        self._loaded = False
+        # name -> live extents [(kind, payload_off, payload_len)]:
+        # an image resets the list, records append, a tombstone clears
+        self._feeds: Dict[str, List[Tuple[int, int, int]]] = {}
+        self._end = 0  # valid end of the slab file
+        self._live_bytes = 0  # header+payload bytes of live segments
+        self._fh: Optional[io.BufferedRandom] = None
+        self._mm: Optional[mmap.mmap] = None
+        self._mm_size = 0
+        self._idx_fh = None
+        self._closed = False
+
+    # -- index ----------------------------------------------------------
+
+    def _ensure_loaded(self) -> None:
+        if self._loaded:
+            return
+        self._loaded = True
+        self._end = len(_HDR.pack(_MAGIC, _VERSION))
+        try:
+            slab_size = os.path.getsize(self.path)
+        except OSError:
+            return
+        entries, idx_ok = self._read_index(slab_size)
+        if not idx_ok:
+            entries = []
+        pos = len(_HDR.pack(_MAGIC, _VERSION))
+        for kind, name, off, ln in entries:
+            self._apply(kind, name, off, ln)
+            pos = off + ln
+        # repair forward: segments appended after the last indexed one
+        # (crash between the slab append and the index append), or the
+        # whole file when the index was unusable
+        recovered = self._scan(pos, slab_size)
+        if recovered:
+            for kind, name, off, ln in recovered:
+                self._apply(kind, name, off, ln)
+            if idx_ok:
+                for e in recovered:
+                    self._append_idx(*e)
+            else:
+                self._rewrite_idx()
+        elif not idx_ok:
+            self._rewrite_idx()
+
+    def _read_index(self, slab_size: int):
+        """([(kind, name, payload_off, payload_len)], usable) — usable is
+        False when the index is missing or inconsistent with the slab."""
+        try:
+            with open(self.idx_path, "rb") as fh:
+                raw = fh.read()
+        except OSError:
+            return [], False
+        out = []
+        pos = 0
+        end = len(raw)
+        prev_end = len(_HDR.pack(_MAGIC, _VERSION))
+        while pos + _SEG.size <= end:
+            kind, nlen = _SEG.unpack_from(raw, pos)
+            p = pos + _SEG.size
+            if p + nlen + 16 > end:
+                break  # torn index tail: entries so far remain usable
+            name = raw[p : p + nlen].decode("ascii", "replace")
+            off, ln = struct.unpack_from("<QQ", raw, p + nlen)
+            if off < prev_end or off + ln > slab_size:
+                return [], False  # inconsistent: rebuild by scan
+            out.append((kind, name, off, ln))
+            prev_end = off + ln
+            pos = p + nlen + 16
+        return out, True
+
+    def _scan(self, start: int, slab_size: int):
+        """Parse slab segment headers in [start, slab_size); stops at a
+        torn tail."""
+        if start >= slab_size:
+            return []
+        out = []
+        with open(self.path, "rb") as fh:
+            mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+            try:
+                pos = start
+                while pos + _SEG.size <= slab_size:
+                    kind, nlen = _SEG.unpack_from(mm, pos)
+                    p = pos + _SEG.size
+                    if kind not in (
+                        KIND_IMAGE, KIND_RECORD, KIND_TOMBSTONE
+                    ) or p + nlen + _LEN.size > slab_size:
+                        break
+                    name = mm[p : p + nlen].decode("ascii", "replace")
+                    (ln,) = _LEN.unpack_from(mm, p + nlen)
+                    off = p + nlen + _LEN.size
+                    if off + ln > slab_size:
+                        break  # torn tail
+                    out.append((kind, name, off, ln))
+                    pos = off + ln
+            finally:
+                mm.close()
+        return out
+
+    def _apply(self, kind: int, name: str, off: int, ln: int) -> None:
+        seg_bytes = _SEG.size + len(name) + _LEN.size + ln
+        if kind == KIND_IMAGE:
+            for _k, _o, dead in self._feeds.get(name, ()):
+                self._live_bytes -= _SEG.size + len(name) + _LEN.size + dead
+            self._feeds[name] = [(kind, off, ln)]
+            self._live_bytes += seg_bytes
+        elif kind == KIND_RECORD:
+            self._feeds.setdefault(name, []).append((kind, off, ln))
+            self._live_bytes += seg_bytes
+        else:  # tombstone
+            for _k, _o, dead in self._feeds.get(name, ()):
+                self._live_bytes -= _SEG.size + len(name) + _LEN.size + dead
+            self._feeds[name] = []
+        self._end = off + ln
+
+    # -- reads ----------------------------------------------------------
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            self._ensure_loaded()
+            return name in self._feeds
+
+    def feed_live(self, name: str) -> bool:
+        """True iff the feed has live (non-tombstoned) segments."""
+        with self._lock:
+            self._ensure_loaded()
+            return bool(self._feeds.get(name))
+
+    def feed_names(self) -> List[str]:
+        with self._lock:
+            self._ensure_loaded()
+            return [n for n, segs in self._feeds.items() if segs]
+
+    def _mapped(self) -> Optional[mmap.mmap]:
+        # caller holds the lock. The mapping is reused stat-free until
+        # an append invalidates it (_mm is cleared there) — a bulk cold
+        # open slices it thousands of times.
+        if self._mm is not None:
+            return self._mm
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return None
+        if size == 0:
+            return None
+        with open(self.path, "rb") as fh:
+            self._mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        self._mm_size = size
+        return self._mm
+
+    def image_bytes(self, name: str) -> bytes:
+        """The feed's sidecar image in FileColumnStorageV2 byte format:
+        live image segment + record segments, concatenated. One mmap
+        slice per segment — the cold-open common case is exactly one."""
+        with self._lock:
+            self._ensure_loaded()
+            segs = self._feeds.get(name)
+            if not segs:
+                return b""
+            mm = self._mapped()
+            if mm is None:
+                return b""
+            if len(segs) == 1:
+                _k, off, ln = segs[0]
+                return mm[off : off + ln]
+            return b"".join(mm[off : off + ln] for _k, off, ln in segs)
+
+    # -- writes ---------------------------------------------------------
+
+    def _writable(self):
+        if self._fh is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            fresh = not os.path.exists(self.path)
+            self._fh = open(self.path, "w+b" if fresh else "r+b")
+            if fresh:
+                self._fh.write(_HDR.pack(_MAGIC, _VERSION))
+                self._fh.flush()
+                self._end = self._fh.tell()
+            self._idx_fh = open(self.idx_path, "ab")
+        return self._fh
+
+    def append(self, kind: int, name: str, payload: bytes) -> None:
+        with self._lock:
+            self._ensure_loaded()
+            fh = self._writable()
+            nb = name.encode("ascii")
+            head = _SEG.pack(kind, len(nb)) + nb + _LEN.pack(len(payload))
+            fh.seek(self._end)  # overwrite any torn tail
+            fh.write(head)
+            fh.write(payload)
+            fh.truncate()
+            fh.flush()
+            off = self._end + len(head)
+            self._apply(kind, name, off, len(payload))
+            if self._mm is not None:
+                self._mm.close()  # stale mapping: remap on next read
+                self._mm = None
+                self._mm_size = 0
+            self._append_idx(kind, name, off, len(payload))
+
+    def _append_idx(self, kind, name, off, ln) -> None:
+        if self._idx_fh is None:
+            self._idx_fh = open(self.idx_path, "ab")
+        nb = name.encode("ascii")
+        self._idx_fh.write(
+            _SEG.pack(kind, len(nb)) + nb + struct.pack("<QQ", off, ln)
+        )
+        self._idx_fh.flush()
+
+    def _rewrite_idx(self) -> None:
+        # entries MUST be offset-ordered: _read_index treats any
+        # non-monotonic offset as corruption (a feed-grouped dump of
+        # interleaved segments would fail that check on every open)
+        entries = sorted(
+            (off, ln, kind, name)
+            for name, segs in self._feeds.items()
+            for kind, off, ln in segs
+        )
+        tmp = self.idx_path + ".tmp"
+        with open(tmp, "wb") as fh:
+            for off, ln, kind, name in entries:
+                nb = name.encode("ascii")
+                fh.write(
+                    _SEG.pack(kind, len(nb))
+                    + nb
+                    + struct.pack("<QQ", off, ln)
+                )
+        os.replace(tmp, self.idx_path)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def compact(self, force: bool = False) -> bool:
+        """Rewrite the slab keeping only live segments. Returns True when
+        a rewrite happened. Without `force`, only when the dead fraction
+        exceeds HM_SLAB_SLACK (and at least 4KB of dead bytes)."""
+        with self._lock:
+            self._ensure_loaded()
+            if not os.path.exists(self.path):
+                return False
+            dead = self._end - len(_HDR.pack(_MAGIC, _VERSION)) - (
+                self._live_bytes
+            )
+            if not force and (
+                dead < 4096
+                or dead < _slack_fraction() * max(self._end, 1)
+            ):
+                return False
+            mm = self._mapped()
+            if mm is None:
+                return False
+            tmp = self.path + ".tmp"
+            new_feeds: Dict[str, List[Tuple[int, int, int]]] = {}
+            with open(tmp, "wb") as fh:
+                fh.write(_HDR.pack(_MAGIC, _VERSION))
+                for name, segs in self._feeds.items():
+                    if not segs:
+                        continue  # tombstoned: simply absent after rewrite
+                    nb = name.encode("ascii")
+                    out = []
+                    for kind, off, ln in segs:
+                        head = _SEG.pack(kind, len(nb)) + nb + _LEN.pack(ln)
+                        fh.write(head)
+                        fh.write(mm[off : off + ln])
+                        out.append((kind, fh.tell() - ln, ln))
+                    new_feeds[name] = out
+                fh.flush()
+                os.fsync(fh.fileno())
+                new_end = fh.tell()
+            self._close_files()
+            os.replace(tmp, self.path)
+            self._feeds = new_feeds
+            self._end = new_end
+            self._live_bytes = new_end - len(_HDR.pack(_MAGIC, _VERSION))
+            self._rewrite_idx()
+            return True
+
+    def _close_files(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+            self._mm_size = 0
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        if self._idx_fh is not None:
+            self._idx_fh.close()
+            self._idx_fh = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            if self._loaded:
+                try:
+                    self.compact()
+                except OSError:
+                    pass  # read-only media: slack stays until writable
+            self._close_files()
+
+    def destroy(self) -> None:
+        with self._lock:
+            self._close_files()
+            for p in (self.path, self.idx_path):
+                if os.path.exists(p):
+                    os.remove(p)
+            self._feeds = {}
+            self._loaded = True
+            self._end = len(_HDR.pack(_MAGIC, _VERSION))
+            self._live_bytes = 0

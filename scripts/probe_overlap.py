@@ -55,7 +55,7 @@ def main():
     def compile_bg():
         t0 = time.perf_counter()
         out, summary = run_batch_full(batch, lean=True)
-        np.asarray(summary.clock.ravel()[:1])
+        np.asarray(summary.ravel()[:1])
         done["t"] = time.perf_counter() - t0
 
     th = threading.Thread(target=compile_bg)

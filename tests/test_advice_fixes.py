@@ -223,38 +223,58 @@ def test_bulk_load_falls_back_on_seq_gap(tmp_path):
     repo.close()
 
     # corrupt the sidecar: bump the last change's seq to fake a gap
+    # (sidecars live in the corpus slab now — supersede each feed's
+    # image with the edited record stream; `.cols2` files are walked
+    # too for the HM_SLAB=0 layout)
     from hypermerge_tpu.storage.colcache import (
         FileColumnStorageV2,
+        file_column_storage_fn,
         pack_v2_record,
     )
 
     feeds_dir = os.path.join(path, "feeds")
+
+    def _edit(rows, preds, tables, commits):
+        if not len(rows):
+            return None
+        max_seq = rows[:, 2].max()
+        if max_seq < 2:
+            return None
+        rows = rows.copy()
+        rows[rows[:, 2] == max_seq, 2] = max_seq + 1
+        # re-frame the same per-change records with the edited rows
+        recs = []
+        pr = pp = pt = 0
+        for tr, tp, tt, flag in commits:
+            recs.append(
+                pack_v2_record(
+                    rows[pr:tr], preds[pp:tp], tables[pt:tt], flag
+                )
+            )
+            pr, pp, pt = tr, tp, tt
+        return b"".join(recs)
+
     edited = False
+    fn = file_column_storage_fn(feeds_dir)
+    if fn.slab is not None:
+        from hypermerge_tpu.storage.slab import KIND_IMAGE
+
+        for name in fn.slab.feed_names():
+            blob = _edit(*fn(name).load())
+            if blob is not None:
+                fn.slab.append(KIND_IMAGE, name, blob)
+                edited = True
+        fn.slab.close()
     for root, _dirs, files in os.walk(feeds_dir):
         for f in files:
             if not f.endswith(".cols2"):
                 continue
             st = FileColumnStorageV2(os.path.join(root, f))
-            rows, preds, tables, commits = st.load()
-            if not len(rows):
+            blob = _edit(*st.load())
+            if blob is None:
                 continue
-            max_seq = rows[:, 2].max()
-            if max_seq < 2:
-                continue
-            rows = rows.copy()
-            rows[rows[:, 2] == max_seq, 2] = max_seq + 1
-            # re-frame the same per-change records with the edited rows
-            recs = []
-            pr = pp = pt = 0
-            for tr, tp, tt, flag in commits:
-                recs.append(
-                    pack_v2_record(
-                        rows[pr:tr], preds[pp:tp], tables[pt:tt], flag
-                    )
-                )
-                pr, pp, pt = tr, tp, tt
             with open(os.path.join(root, f), "wb") as fh:
-                fh.write(b"".join(recs))
+                fh.write(blob)
             edited = True
     assert edited
 

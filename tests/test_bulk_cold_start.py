@@ -394,7 +394,7 @@ def test_actor_columns_rebuild_from_blocks(tmp_path):
         want = plainify(repo.doc(url))
         repo.close()
 
-        # blow away every sidecar (legacy dirs and v2 files)
+        # blow away every sidecar (slab, legacy dirs, and v2 files)
         import os
 
         for root, dirs, files in os.walk(os.path.join(tmp, "feeds")):
@@ -402,7 +402,7 @@ def test_actor_columns_rebuild_from_blocks(tmp_path):
                 if d.endswith(".cols"):
                     shutil.rmtree(os.path.join(root, d))
             for f in files:
-                if f.endswith(".cols2"):
+                if f.endswith(".cols2") or f.startswith("cols.slab"):
                     os.remove(os.path.join(root, f))
         repo2 = Repo(path=tmp)
         doc_id = validate_doc_url(url)

@@ -94,6 +94,18 @@ def test_frontend_drives_backend_subprocess(tmp_path):
         _wait(lambda: (_val(h) or {}).get("n") == 7)
         assert h.value() == {"title": "split", "n": 7}
         assert states, "watch callbacks never fired across the boundary"
+
+        # durability gate BEFORE teardown: the handle echo alone can be
+        # satisfied while the Change message is still in flight to the
+        # backend; a meta round-trip on the same ordered channel proves
+        # the backend applied (and therefore persisted) both changes
+        def backend_history():
+            got = []
+            front.meta(url, got.append)
+            _wait(lambda: got, timeout=10)
+            return ((got[0] or {}).get("history")) or 0
+
+        _wait(lambda: backend_history() >= 2, timeout=30)
         h.close()
         close()
 
@@ -316,3 +328,49 @@ def test_reopen_same_doc_while_backend_alive(tmp_path):
         close()
     finally:
         _stop(proc, sock)
+
+
+def test_persistent_backend_reused_across_frontend_cycles(tmp_path):
+    """Non-once mode: ONE live backend serves successive frontends —
+    state written by frontend A is visible to frontend B without a
+    backend rebuild (a :memory: repo would lose everything otherwise),
+    and nothing piles up per cycle."""
+    import gc
+
+    from hypermerge_tpu.backend.repo_backend import RepoBackend
+    from hypermerge_tpu.net.ipc import connect_frontend, serve_backend
+
+    sock = str(tmp_path / "backend.sock")
+    server = threading.Thread(
+        target=serve_backend,
+        kwargs=dict(sock_path=sock, memory=True, once=False),
+        daemon=True,
+    )
+    server.start()
+    deadline = time.time() + 30
+    while time.time() < deadline and not os.path.exists(sock):
+        time.sleep(0.02)
+    assert os.path.exists(sock)
+
+    front_a, close_a = connect_frontend(sock)
+    url = front_a.create({"cycle": 1})
+    ha = front_a.open(url)
+    _wait(lambda: (_val(ha) or {}).get("cycle") == 1)
+    close_a()
+    time.sleep(0.2)  # let the server notice the close
+
+    backends_before = sum(
+        isinstance(o, RepoBackend) for o in gc.get_objects()
+    )
+    front_b, close_b = connect_frontend(sock)
+    # the SAME backend answers: frontend A's doc is still there
+    hb = front_b.open(url)
+    _wait(lambda: (_val(hb) or {}).get("cycle") == 1)
+    close_b()
+    time.sleep(0.2)
+    backends_after = sum(
+        isinstance(o, RepoBackend) for o in gc.get_objects()
+    )
+    assert backends_after <= backends_before, (
+        "backends piled up across frontend cycles"
+    )

@@ -421,6 +421,76 @@ class TestSparseFetch:
         f.append(b"real1")
         assert f.get_sparse(1) == b"real1"
 
+    def test_unsolicited_sparse_push_never_lands(self):
+        """A push of VALID proof-carrying blocks the receiver never
+        requested must neither store blocks nor grow memory — only
+        outstanding requested ranges may land."""
+        import base64 as b64mod
+
+        feeds_a, feeds_b, mgr_a, mgr_b, pb = self._pair()
+        pair = keymod.create()
+        fa = feeds_a.create(pair)
+        for i in range(64):
+            fa.append(b"blk%d" % i)
+        fb = feeds_b.open_feed(pair.public_key)
+        mgr_a.announce(fa)
+        mgr_b.announce(fb)
+        # B never called request_range: craft a fully VALID frame
+        served = fa.integrity.range_proofs(fa, 10, 14)
+        length, sig, pairs = served
+        mgr_b._on_sparse_blocks(
+            pb,
+            fa.discovery_id,
+            10,
+            length,
+            b64mod.b64encode(sig).decode(),
+            [b64mod.b64encode(b).decode() for b, _p in pairs],
+            [
+                [b64mod.b64encode(h).decode() for h in p]
+                for _b, p in pairs
+            ],
+        )
+        assert not any(fb.has_block(i) for i in range(10, 14))
+        assert len(fb._sparse) == 0, "unsolicited push grew the buffer"
+
+        # a real request keeps working, and indices OUTSIDE it drop
+        wait_until(lambda: mgr_b.request_range(fa.discovery_id, 20, 22))
+        wait_until(lambda: fb.has_block(21))
+        assert fb.get_sparse(20) == b"blk20"
+        before = len(fb._sparse)
+        mgr_b._on_sparse_blocks(  # replay of the unrequested frame
+            pb,
+            fa.discovery_id,
+            10,
+            length,
+            b64mod.b64encode(sig).decode(),
+            [b64mod.b64encode(b).decode() for b, _p in pairs],
+            [
+                [b64mod.b64encode(h).decode() for h in p]
+                for _b, p in pairs
+            ],
+        )
+        assert len(fb._sparse) == before
+        assert not fb.has_block(10)
+
+    def test_sparse_buffer_cap_evicts_furthest(self, monkeypatch):
+        """HM_SPARSE_CAP bounds Feed._sparse; eviction drops the entry
+        FURTHEST beyond the contiguous head (nearest blocks are about
+        to be absorbed by backfill; far ones re-fetch)."""
+        monkeypatch.setenv("HM_SPARSE_CAP", "4")
+        feeds = FeedStore(memory_storage_fn)
+        f = feeds.create(keymod.create())
+        for i in range(10, 22):
+            f.put_sparse(i, b"s%d" % i)
+        assert len(f._sparse) == 4
+        assert sorted(f._sparse) == [10, 11, 12, 13]
+        # nearer-than-buffered still displaces the furthest
+        f.put_sparse(5, b"s5")
+        assert sorted(f._sparse) == [5, 10, 11, 12]
+        # duplicates of buffered indices never evict
+        f.put_sparse(11, b"s11")
+        assert sorted(f._sparse) == [5, 10, 11, 12]
+
 
 class TestJoinOptions:
     """Discovery asymmetry (VERDICT r5 item 9; reference

@@ -1,0 +1,263 @@
+"""Corpus slab (storage/slab.py): one file of framed sidecar segments.
+
+Pins the properties the cold-open IO path leans on: byte-identical
+loads vs the per-feed layout, O(1) file opens, lazy migration of legacy
+`.cols2` sidecars, torn-tail healing on both the slab and its index,
+tombstones on destroy, and compaction reclaiming superseded bytes."""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+from helpers import Site, plainify, random_mutation
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.storage.colcache import (
+    FeedColumnCache,
+    SlabColumnStorage,
+    file_column_storage_fn,
+)
+from hypermerge_tpu.storage.slab import (
+    KIND_IMAGE,
+    CorpusSlab,
+)
+from hypermerge_tpu.utils.ids import validate_doc_url
+
+INF = float("inf")
+
+
+def _history(seed, n_mut=15):
+    r = random.Random(seed)
+    site = Site("actor00")
+    for _ in range(n_mut):
+        random_mutation(site, r)
+    return list(site.opset.history)
+
+
+def _fill(tmp_path, names=("feedA", "feedB"), seed=1):
+    fn = file_column_storage_fn(str(tmp_path))
+    want = {}
+    for i, name in enumerate(names):
+        cc = FeedColumnCache(fn(name), writer="actor00")
+        for c in _history(seed + i):
+            cc.append_change(c)
+        want[name] = cc.columns().ensure_rows().copy()
+        cc.close()
+    if fn.slab is not None:
+        fn.slab.close()
+    return want
+
+
+def test_slab_roundtrip_and_single_file(tmp_path):
+    want = _fill(tmp_path)
+    assert os.path.exists(tmp_path / "cols.slab")
+    assert not list(tmp_path.glob("*/*.cols2"))
+    fn = file_column_storage_fn(str(tmp_path))
+    for name, rows in want.items():
+        cc = FeedColumnCache(fn(name), writer="actor00")
+        assert np.array_equal(cc.columns().ensure_rows(), rows)
+        cc.close()
+    fn.slab.close()
+
+
+def test_slab_checkpoint_load_is_plane_backed(tmp_path):
+    """Compacted feeds load as planes with plane_meta (what both the
+    numpy and native bulk packs consume)."""
+    fn = file_column_storage_fn(str(tmp_path))
+    cc = FeedColumnCache(fn("feedX"), writer="actor00")
+    for c in _history(7):
+        cc.append_change(c)
+    cc.compact()
+    cc.close()
+    fn.slab.close()
+
+    fn2 = file_column_storage_fn(str(tmp_path))
+    cc2 = FeedColumnCache(fn2("feedX"), writer="actor00")
+    fc = cc2.columns()
+    assert fc.planes is not None
+    assert fc.plane_meta is not None
+    cc2.close()
+    fn2.slab.close()
+
+
+def test_legacy_cols2_migrates_on_first_read(tmp_path, monkeypatch):
+    """A per-feed `.cols2` sidecar written by an older version folds
+    into the slab on first read and the legacy file is removed."""
+    monkeypatch.setenv("HM_SLAB", "0")
+    want = _fill(tmp_path, names=("feedL",), seed=3)["feedL"]
+    legacy = tmp_path / "fe" / "feedL.cols2"
+    assert legacy.exists()
+
+    monkeypatch.setenv("HM_SLAB", "1")
+    fn = file_column_storage_fn(str(tmp_path))
+    storage = fn("feedL")
+    assert isinstance(storage, SlabColumnStorage)
+    cc = FeedColumnCache(storage, writer="actor00")
+    assert np.array_equal(cc.columns().ensure_rows(), want)
+    cc.close()
+    assert not legacy.exists(), "legacy sidecar not migrated"
+    assert fn.slab.feed_live("feedL")
+    fn.slab.close()
+
+    # second open: slab-only
+    fn2 = file_column_storage_fn(str(tmp_path))
+    cc2 = FeedColumnCache(fn2("feedL"), writer="actor00")
+    assert np.array_equal(cc2.columns().ensure_rows(), want)
+    cc2.close()
+    fn2.slab.close()
+
+
+def test_torn_slab_tail_healed(tmp_path):
+    want = _fill(tmp_path)
+    p = tmp_path / "cols.slab"
+    with open(p, "ab") as fh:
+        fh.write(b"\x01\x00\x04torn-segment-header-without-payload")
+    # index is now BEHIND the garbage; loads must ignore the torn tail
+    fn = file_column_storage_fn(str(tmp_path))
+    for name, rows in want.items():
+        cc = FeedColumnCache(fn(name), writer="actor00")
+        assert np.array_equal(cc.columns().ensure_rows(), rows)
+        cc.close()
+    # and the next append lands cleanly over it
+    cc = FeedColumnCache(fn("feedC"), writer="actor00")
+    for c in _history(9, n_mut=4):
+        cc.append_change(c)
+    got = cc.columns().ensure_rows().copy()
+    cc.close()
+    fn.slab.close()
+    fn2 = file_column_storage_fn(str(tmp_path))
+    cc2 = FeedColumnCache(fn2("feedC"), writer="actor00")
+    assert np.array_equal(cc2.columns().ensure_rows(), got)
+    cc2.close()
+    fn2.slab.close()
+
+
+def test_missing_or_torn_index_rebuilds(tmp_path):
+    want = _fill(tmp_path)
+    # interleave: a record for feedA lands AFTER feedB's image
+    fni = file_column_storage_fn(str(tmp_path))
+    cci = FeedColumnCache(fni("feedA"), writer="actor00")
+    for c in _history(8, n_mut=3):
+        cci.append_change(c)
+    want["feedA"] = cci.columns().ensure_rows().copy()
+    cci.close()
+    fni.slab.close()
+
+    os.remove(tmp_path / "cols.slab.idx")
+    fn = file_column_storage_fn(str(tmp_path))
+    for name, rows in want.items():
+        cc = FeedColumnCache(fn(name), writer="actor00")
+        assert np.array_equal(cc.columns().ensure_rows(), rows)
+        cc.close()
+    fn.slab.close()
+    assert os.path.exists(tmp_path / "cols.slab.idx")  # rebuilt
+    # ...and the rebuild is offset-ordered: the next open must accept it
+    # (a feed-grouped dump would fail the monotonic check and force a
+    # full slab scan on EVERY open)
+    probe = CorpusSlab(str(tmp_path / "cols.slab"))
+    entries, usable = probe._read_index(
+        os.path.getsize(tmp_path / "cols.slab")
+    )
+    assert usable and entries, "rebuilt index rejected on reopen"
+    probe.close()
+
+    # torn index tail: truncate mid-entry
+    raw = (tmp_path / "cols.slab.idx").read_bytes()
+    (tmp_path / "cols.slab.idx").write_bytes(raw[: len(raw) - 7])
+    fn2 = file_column_storage_fn(str(tmp_path))
+    for name, rows in want.items():
+        cc = FeedColumnCache(fn2(name), writer="actor00")
+        assert np.array_equal(cc.columns().ensure_rows(), rows)
+        cc.close()
+    fn2.slab.close()
+
+
+def test_index_repairs_forward_after_lost_entry(tmp_path):
+    """A crash between the slab append and the index append leaves the
+    index one entry short: open() must recover the segment by scanning
+    forward from the last indexed extent."""
+    want = _fill(tmp_path, names=("feedA",), seed=5)["feedA"]
+    slab = CorpusSlab(str(tmp_path / "cols.slab"))
+    idx_before = (tmp_path / "cols.slab.idx").read_bytes()
+    slab.append(KIND_IMAGE, "feedZ", b"HMc3" + b"\x00" * 16)  # bogus-ish
+    slab.close()
+    (tmp_path / "cols.slab.idx").write_bytes(idx_before)
+
+    slab2 = CorpusSlab(str(tmp_path / "cols.slab"))
+    assert slab2.feed_live("feedZ"), "unindexed segment not recovered"
+    assert slab2.feed_live("feedA")
+    slab2.close()
+    # feedA still loads
+    fn = file_column_storage_fn(str(tmp_path))
+    cc = FeedColumnCache(fn("feedA"), writer="actor00")
+    assert np.array_equal(cc.columns().ensure_rows(), want)
+    cc.close()
+    fn.slab.close()
+
+
+def test_tombstone_and_compaction_reclaim(tmp_path, monkeypatch):
+    monkeypatch.setenv("HM_SLAB_SLACK", "0.01")
+    fn = file_column_storage_fn(str(tmp_path))
+    history = _history(11, n_mut=40)
+    cc = FeedColumnCache(fn("feedA"), writer="actor00")
+    for c in history:
+        cc.append_change(c)
+    for _ in range(4):  # superseded images pile up
+        cc.compact()
+    want = cc.columns().ensure_rows().copy()
+    cc.close()
+    cc2 = FeedColumnCache(fn("feedB"), writer="actor00")
+    for c in _history(12, n_mut=20):
+        cc2.append_change(c)
+    cc2.destroy()  # tombstoned
+    size_before = os.path.getsize(tmp_path / "cols.slab")
+    fn.slab.close()  # compacts: dead images + tombstoned feed drop
+    size_after = os.path.getsize(tmp_path / "cols.slab")
+    assert size_after < size_before
+
+    fn2 = file_column_storage_fn(str(tmp_path))
+    assert not fn2.slab.feed_live("feedB")
+    cc3 = FeedColumnCache(fn2("feedA"), writer="actor00")
+    assert np.array_equal(cc3.columns().ensure_rows(), want)
+    cc3.close()
+    fn2.slab.close()
+
+
+def test_repo_end_to_end_uses_slab(tmp_path):
+    """Interactive writes + reopen + bulk load, all through the slab."""
+    path = str(tmp_path)
+    repo = Repo(path=path)
+    urls = [repo.create({"i": i}) for i in range(4)]
+    for u in urls:
+        repo.change(u, lambda d: d.__setitem__("y", 1))
+    want = {u: plainify(repo.doc(u)) for u in urls}
+    repo.close()
+    assert os.path.exists(os.path.join(path, "feeds", "cols.slab"))
+    assert not [
+        f
+        for _r, _d, fs in os.walk(os.path.join(path, "feeds"))
+        for f in fs
+        if f.endswith(".cols2")
+    ]
+
+    repo2 = Repo(path=path)
+    ids = [validate_doc_url(u) for u in urls]
+    repo2.back.load_documents_bulk(ids)
+    for u in urls:
+        assert plainify(repo2.doc(u)) == want[u]
+    repo2.close()
+
+
+def test_slab_disabled_fallback(tmp_path, monkeypatch):
+    """HM_SLAB=0 restores the per-feed `.cols2` layout end to end."""
+    monkeypatch.setenv("HM_SLAB", "0")
+    path = str(tmp_path)
+    repo = Repo(path=path)
+    url = repo.create({"x": 1})
+    want = plainify(repo.doc(url))
+    repo.close()
+    assert not os.path.exists(os.path.join(path, "feeds", "cols.slab"))
+    repo2 = Repo(path=path)
+    assert plainify(repo2.doc(url)) == want
+    repo2.close()

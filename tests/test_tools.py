@@ -202,3 +202,62 @@ def test_simple_example_converges():
     )
     assert out.returncode == 0, out.stderr
     assert "converged: {'numbers': [1, 2, 3, 4, 5]" in out.stdout
+
+
+def test_meta_tool_docs_and_files(tmp_path):
+    """tools/meta.py surfaces repo.meta — actor list, clock, history
+    for docs; size/mime for hyperfiles (reference tools/Meta.ts)."""
+    from hypermerge_tpu.utils.ids import validate_doc_url
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"n": 0})
+    repo.change(url, lambda d: d.__setitem__("n", 1))
+    repo.change(url, lambda d: d.__setitem__("m", 2))
+    import io
+    import tempfile
+
+    repo.start_file_server(tempfile.mktemp(suffix=".sock"))
+    header = repo.files.write(
+        io.BytesIO(b"\xab" * 4096), "application/x-blob"
+    )
+    file_url = header.url
+    repo.close()
+
+    out = _run(["tools/meta.py", path, url])
+    assert out.returncode == 0, out.stderr
+    meta = json.loads(out.stdout.strip().splitlines()[-1])
+    assert meta["type"] == "Document"
+    assert meta["history"] == 3
+    doc_id = validate_doc_url(url)
+    assert doc_id in meta["actors"]
+    assert any(c.startswith(doc_id) for c in meta["clock"])
+
+    out = _run(["tools/meta.py", path, file_url])
+    assert out.returncode == 0, out.stderr
+    fmeta = json.loads(out.stdout.strip().splitlines()[-1])
+    assert fmeta["type"] == "File"
+    assert fmeta["bytes"] == 4096
+    assert fmeta["mimeType"] == "application/x-blob"
+
+    # unknown (but well-formed) url: null + non-zero exit
+    from hypermerge_tpu.utils import keys as keymod
+
+    bogus = "hyperfile:/" + keymod.create().public_key
+    out = _run(["tools/meta.py", path, bogus])
+    assert out.returncode == 1
+    assert out.stdout.strip().splitlines()[-1] == "null"
+
+
+def test_meta_tool_unknown_doc_times_out_to_null(tmp_path):
+    from hypermerge_tpu.utils import keys as keymod
+    from hypermerge_tpu.utils.ids import to_doc_url
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    repo.create({"x": 1})
+    repo.close()
+    unknown = to_doc_url(keymod.create().public_key)
+    out = _run(["tools/meta.py", path, unknown, "--timeout", "3"])
+    assert out.returncode == 1
+    assert out.stdout.strip().splitlines()[-1] == "null"

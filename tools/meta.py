@@ -1,0 +1,66 @@
+"""Print a url's metadata: for a document, its actor list, clock, and
+history length; for a hyperfile, its size and mime type (reference
+tools/Meta.ts — `repo.meta(url, cb)` surfaced on the command line).
+
+    python tools/meta.py /path/to/repo 'hypermerge:/<docId>'
+    python tools/meta.py /path/to/repo 'hyperfile:/<fileId>'
+
+Output is one JSON object. Documents are opened first (metadata queries
+answer from the open doc's backend state); unknown urls print null and
+exit non-zero.
+"""
+
+import argparse
+import json
+import sys
+import threading
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.utils.ids import is_doc_url  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", help="repo directory")
+    ap.add_argument("url", help="hypermerge:/ doc url or hyperfile:/ url")
+    ap.add_argument(
+        "--timeout", type=float, default=30.0,
+        help="seconds to wait for the doc to come up (default 30)",
+    )
+    args = ap.parse_args()
+
+    repo = Repo(path=args.repo)
+    try:
+        if is_doc_url(args.url):
+            # metadata answers from the open doc: materialize it first
+            try:
+                repo.open(args.url).value(timeout=args.timeout)
+            except TimeoutError:
+                # unknown doc (nothing local, no peer): same contract
+                # as an unknown hyperfile — null, non-zero exit
+                print("null", flush=True)
+                sys.exit(1)
+        got = {}
+        done = threading.Event()
+
+        def on_meta(payload) -> None:
+            got["meta"] = payload
+            done.set()
+
+        repo.meta(args.url, on_meta)
+        if not done.wait(args.timeout):
+            print("timed out waiting for metadata", file=sys.stderr)
+            sys.exit(2)
+        meta = got["meta"]
+        print(json.dumps(meta, default=str, sort_keys=True), flush=True)
+        if meta is None:
+            sys.exit(1)
+    finally:
+        repo.close()
+
+
+if __name__ == "__main__":
+    main()
