@@ -26,6 +26,13 @@ Baseline = the framework's own host incremental OpSet replay of the same
 per-doc histories (the reference publishes no numbers, BASELINE.md; the
 reference's own cold start is the same work in Node+Immutable.js).
 
+The timed path runs the streaming slab pipeline (backend/pipeline.py,
+the product default): per-slab IO, native pack, device dispatch, and
+summary fetch overlap, so the wall clock is the reported
+`wall_critical_path` (~max(stage)) and the per-stage numbers are BUSY
+times (`t_*_busy` aliases). HM_PIPELINE=0 restores the serial twin,
+where the same keys are back-to-back wall times.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "configs": {...}}. Env: BENCH_DOCS (default 10240), BENCH_OPS (1024),
 BENCH_HOST_DOCS (8), BENCH_DIR (corpus location, default a fresh tmpdir).
@@ -384,29 +391,82 @@ def main() -> None:
     assert stats2.get("fallback", 0) == 0, stats2
 
     # -- stage breakdown + multi-chip projection (VERDICT r5 item 1) --
-    # host-serial stages run on one core and do NOT divide across
-    # chips; device stages (per-chip transfers + kernel + summary
-    # fetch) do. `other` is frontend/handle/queue time we count as host.
+    # Serial mode (HM_PIPELINE=0): stage keys are wall times that SUM
+    # to the cold open, host stages don't divide across chips, so the
+    # projection is host + other + device/8.
+    # Pipeline mode (default): stage keys are per-stage BUSY times and
+    # the stages OVERLAP — the wall clock is `wall_critical_path`
+    # (~max(stage), not sum), and the 8-chip projection is the critical
+    # path with only the device leg divided: other + max(host stages,
+    # device/8). The t_*_busy aliases + wall_critical_path go into the
+    # JSON so the driver sees both views.
+    pipelined = bool(stats2.get("pipeline", 0))
     host_keys = ("t_sql", "t_io", "t_spec", "t_pack", "t_narrow")
-    dev_keys = ("t_upload", "t_dispatch", "t_fetch")
+    # fetch accounting: serial mode pays it at the barrier (t_fetch);
+    # pipeline mode's fetch WORK is t_fetch_busy and the barrier's
+    # t_fetch is residual waiting on that same work — counting both
+    # would double-charge the stage
+    dev_keys = (
+        ("t_upload", "t_dispatch", "t_fetch_busy")
+        if pipelined
+        else ("t_upload", "t_dispatch", "t_fetch")
+    )
     host_s = sum(stats2.get(k, 0.0) for k in host_keys)
     dev_s = sum(stats2.get(k, 0.0) for k in dev_keys)
-    other_s = max(0.0, dt2 - host_s - dev_s)
+    wall_cp = stats2.get("wall_critical_path", dt2)
+    if pipelined:
+        # busy times overlap inside wall_cp, so dt2 - busy would clamp
+        # to 0 precisely when the pipeline works; the serial non-stage
+        # time (repo ctor, handle build, barrier assembly) is the wall
+        # outside the load's critical path
+        other_s = max(0.0, dt2 - wall_cp)
+    else:
+        other_s = max(0.0, dt2 - host_s - dev_s)
     n_proj = 8
-    proj8 = host_s + other_s + dev_s / n_proj
-    stages = {k: stats2.get(k, 0.0) for k in host_keys + dev_keys}
+    if pipelined:
+        # stages overlap: the host-side floor is the single slowest
+        # pipelined host stage, reached when every other stage hides
+        # behind it. t_sql stays OUTSIDE the max — it runs before the
+        # workers start and after they join, so it can never overlap.
+        sql_s = stats2.get("t_sql", 0.0)
+        host_max = max(
+            stats2.get(k, 0.0) for k in host_keys if k != "t_sql"
+        )
+        proj8 = other_s + sql_s + max(host_max, dev_s / n_proj)
+    else:
+        proj8 = host_s + other_s + dev_s / n_proj
+    stages = {
+        k: stats2.get(k, 0.0)
+        for k in host_keys + ("t_upload", "t_dispatch", "t_fetch")
+    }
     stages["other"] = round(other_s, 3)
+    for k, v in stats2.items():
+        if k.endswith("_busy"):
+            stages[k] = v
+    stages["wall_critical_path"] = round(wall_cp, 3)
+    stages["pipeline"] = 1 if pipelined else 0
+    busy_total = host_s + dev_s
     print(
-        f"# stages: host {host_s:.2f}s "
+        f"# stages ({'pipelined busy' if pipelined else 'serial wall'}): "
+        f"host {host_s:.2f}s "
         f"({', '.join(f'{k[2:]}={stats2.get(k, 0.0):.2f}' for k in host_keys)}) "
         f"+ device {dev_s:.2f}s "
         f"({', '.join(f'{k[2:]}={stats2.get(k, 0.0):.2f}' for k in dev_keys)}) "
         f"+ other {other_s:.2f}s",
         file=sys.stderr,
     )
+    if pipelined:
+        overlap = busy_total / wall_cp if wall_cp > 0 else 1.0
+        print(
+            f"# overlap: wall critical path {wall_cp:.2f}s vs "
+            f"{busy_total:.2f}s total stage busy time "
+            f"({overlap:.2f}x concurrency)",
+            file=sys.stderr,
+        )
     print(
-        f"# projection: {n_proj}-chip (device/{n_proj}, host serial) = "
-        f"{proj8:.2f}s -> {total_ops/proj8:,.0f} ops/s",
+        f"# projection: {n_proj}-chip "
+        f"({'overlapped critical path' if pipelined else 'host serial'}, "
+        f"device/{n_proj}) = {proj8:.2f}s -> {total_ops/proj8:,.0f} ops/s",
         file=sys.stderr,
     )
 
@@ -500,6 +560,8 @@ def main() -> None:
                     "stages": stages,
                     "host_serial_s": round(host_s + other_s, 2),
                     "device_s": round(dev_s, 2),
+                    "pipeline": 1 if pipelined else 0,
+                    "wall_critical_path_s": round(wall_cp, 2),
                     "projection_8chip_s": round(proj8, 2),
                 },
             }

@@ -1,0 +1,298 @@
+"""Streaming slab pipeline — overlap IO → pack → dispatch → fetch.
+
+The serial bulk loader pays a cold open as the SUM of its per-slab
+stage costs: sidecar IO, spec, pack, upload/dispatch, and the summary
+fetch each finish completely before the next begins (BENCH_r05: 9.45s
+= 0.37 sql + 1.99 io + 0.21 spec + 2.96 pack + ~0.1 wire + 2.68 fetch
++ 1.14 other). But the stages are independent per slab: slab N+1's
+sidecar reads and native pack need nothing from slab N beyond host
+buffers, and slab N's device work needs nothing from the host at all.
+This module is the classic software-pipelining / double-buffering move
+from accelerator input pipelines: four stages connected by small
+BOUNDED queues so the cold open costs ~max(stage) instead of
+sum(stages), with at most `HM_PIPELINE_DEPTH` (default 2) slabs of
+host staging alive per seam — double buffering, not an unbounded
+backlog.
+
+    io/spec thread:   slab read-ahead (storage/slab.py mmap slices +
+                      colcache decode; file reads drop the GIL) and
+                      per-doc feed specs, emitted as slab-sized entry
+                      groups — composition IDENTICAL to the serial
+                      loader's chunks, so summaries are bit-identical.
+    pack thread:      pack_docs_columns — the native hm_pack_prefix
+                      call is bound through ctypes.CDLL and therefore
+                      RELEASES the GIL (native/__init__.py), so packs
+                      genuinely overlap the io thread's reads.
+    caller thread:    async device upload + dispatch (round-robin
+                      across visible devices via parallel/sharded.py
+                      SlabRoundRobin, mesh-sharded, or single-device)
+                      plus deferred doc init; never blocks on results.
+    fetch thread:     summary wire transfer + host parse for slab N
+                      overlapped with slab N+1's pack; the
+                      materialization barrier (fetch_bulk_summaries)
+                      joins this thread and finds host arrays.
+
+Failure contract: any stage raising aborts the whole pipeline — every
+queue drains, every worker joins (bounded), device refs drop, and the
+caller sees one PipelineError carrying the original exception. A fetch
+failure after the load returned surfaces at the barrier via
+FetchContext.join. The serial path stays available behind
+HM_PIPELINE=0 as the correctness twin.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+
+class PipelineError(RuntimeError):
+    """A pipeline stage failed; the original exception is __cause__."""
+
+
+class _Abort(Exception):
+    """Internal: another stage failed; unwind quietly."""
+
+
+_DONE = object()
+_POLL_S = 0.05
+_JOIN_S = 120.0
+
+
+def pipeline_enabled() -> bool:
+    """Pipeline gate. Explicit HM_PIPELINE=0/1 always wins; the unset
+    default enables the pipeline only when the native GIL-dropping
+    pack is actually loadable (HM_NATIVE_PACK not disabled). With the
+    pure-numpy pack fallback, the pack worker holds the GIL for long
+    stretches and starves the dispatch feeder on a small host — the
+    r5 measurement that kept packing serial — so that configuration
+    stays on the serial twin unless forced."""
+    v = os.environ.get("HM_PIPELINE")
+    if v is not None:
+        return v != "0"
+    if os.environ.get("HM_NATIVE_PACK", "1") == "0":
+        return False
+    from .. import native
+
+    return native.pack_drops_gil()
+
+
+def queue_depth() -> int:
+    return max(1, int(os.environ.get("HM_PIPELINE_DEPTH", "2")))
+
+
+class FetchContext:
+    """Handle on the async fetch stage. The barrier
+    (RepoBackend.fetch_bulk_summaries) joins it before decoding; a
+    fetch error recorded during the overlap window re-raises there."""
+
+    def __init__(self) -> None:
+        self.thread: Optional[threading.Thread] = None
+        self.error: Optional[BaseException] = None
+
+    def join(self, timeout: float = _JOIN_S) -> None:
+        t = self.thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():  # pragma: no cover - defensive
+                raise PipelineError("pipeline fetch stage did not drain")
+        if self.error is not None:
+            raise PipelineError(
+                "bulk summary fetch failed"
+            ) from self.error
+
+
+class SlabPipeline:
+    """One bulk load's stage executor. All callables are supplied by
+    RepoBackend (which owns locks, stats, and device handles):
+
+      prefetch(doc_chunk)      read-ahead actors + sidecar columns
+      classify(doc)            -> ("entry", e) | ("memo", (e, m))
+                                  | ("fallback", doc)
+      pack(entries)            -> ColumnarBatch
+      dispatch(entries, batch) -> pending summary entry (runs on the
+                                  CALLER thread — device dispatch and
+                                  doc init stay single-threaded)
+      fetch(entry)             transfer + parse one slab's summary
+                                  (mutates the entry in place)
+    """
+
+    def __init__(
+        self,
+        docs: List[Any],
+        *,
+        prefetch: Callable[[List[Any]], None],
+        classify: Callable[[Any], Tuple[str, Any]],
+        pack: Callable[[List[Any]], Any],
+        dispatch: Callable[[List[Any], Any], Any],
+        fetch: Callable[[Any], None],
+        slab: int,
+    ) -> None:
+        self.docs = docs
+        self.prefetch = prefetch
+        self.classify = classify
+        self.pack = pack
+        self.dispatch = dispatch
+        self.fetch = fetch
+        self.slab = max(1, int(slab))
+        depth = queue_depth()
+        self.pack_q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.disp_q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.fetch_q: "queue.Queue" = queue.Queue(maxsize=2 * depth)
+        self.abort = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.error_stage: Optional[str] = None
+        self._err_lock = threading.Lock()
+        self.memo_hits: List[Any] = []
+        self.fallbacks: List[Any] = []
+
+    # -- queue plumbing (abort-aware: a failed stage must never leave a
+    # sibling blocked forever on a full/empty bounded queue) ----------
+
+    def _put(self, q: "queue.Queue", item: Any) -> None:
+        while True:
+            if self.abort.is_set():
+                raise _Abort()
+            try:
+                q.put(item, timeout=_POLL_S)
+                return
+            except queue.Full:
+                continue
+
+    def _get(self, q: "queue.Queue") -> Any:
+        while True:
+            if self.abort.is_set():
+                raise _Abort()
+            try:
+                return q.get(timeout=_POLL_S)
+            except queue.Empty:
+                continue
+
+    def _fail(self, stage: str, exc: BaseException) -> None:
+        with self._err_lock:
+            if self.error is None:
+                self.error = exc
+                self.error_stage = stage
+        self.abort.set()
+
+    # -- stages ---------------------------------------------------------
+
+    def _io_loop(self) -> None:
+        """Read-ahead + spec: emits slab-sized entry groups in doc
+        order — exactly the chunks the serial loader would form, so
+        pipeline and serial materialize bit-identical slabs."""
+        try:
+            buf: List[Any] = []
+            for base in range(0, len(self.docs), self.slab):
+                if self.abort.is_set():
+                    raise _Abort()
+                chunk = self.docs[base : base + self.slab]
+                self.prefetch(chunk)
+                for doc in chunk:
+                    kind, payload = self.classify(doc)
+                    if kind == "entry":
+                        buf.append(payload)
+                        if len(buf) == self.slab:
+                            self._put(self.pack_q, buf)
+                            buf = []
+                    elif kind == "memo":
+                        self.memo_hits.append(payload)
+                    else:
+                        self.fallbacks.append(payload)
+            if buf:
+                self._put(self.pack_q, buf)
+            self._put(self.pack_q, _DONE)
+        except _Abort:
+            pass
+        except BaseException as e:
+            self._fail("io", e)
+
+    def _pack_loop(self) -> None:
+        try:
+            while True:
+                item = self._get(self.pack_q)
+                if item is _DONE:
+                    self._put(self.disp_q, _DONE)
+                    return
+                self._put(self.disp_q, (item, self.pack(item)))
+        except _Abort:
+            pass
+        except BaseException as e:
+            self._fail("pack", e)
+
+    def _fetch_loop(self, ctx: FetchContext) -> None:
+        try:
+            while True:
+                item = self._get(self.fetch_q)
+                if item is _DONE:
+                    return
+                self.fetch(item)
+        except _Abort:
+            pass
+        except BaseException as e:
+            self._fail("fetch", e)
+            ctx.error = e
+
+    # -- driver ---------------------------------------------------------
+
+    def run(self, ctx: FetchContext) -> Tuple[List[Any], List[Any]]:
+        """Run the pipeline to completion on the caller thread (which
+        owns dispatch + doc init). Returns (memo_hits, fallbacks); the
+        fetch thread may still be draining — `ctx` tracks it for the
+        barrier. Raises PipelineError if any stage failed."""
+        io_t = threading.Thread(
+            target=self._io_loop, name="hm-pipe-io", daemon=True
+        )
+        pack_t = threading.Thread(
+            target=self._pack_loop, name="hm-pipe-pack", daemon=True
+        )
+        fetch_t = threading.Thread(
+            target=self._fetch_loop,
+            args=(ctx,),
+            name="hm-pipe-fetch",
+            daemon=True,
+        )
+        ctx.thread = fetch_t
+        io_t.start()
+        pack_t.start()
+        fetch_t.start()
+        try:
+            while True:
+                item = self._get(self.disp_q)
+                if item is _DONE:
+                    break
+                entries, batch = item
+                self._put(self.fetch_q, self.dispatch(entries, batch))
+            self._put(self.fetch_q, _DONE)
+        except _Abort:
+            pass
+        except BaseException as e:
+            self._fail("dispatch", e)
+        # upstream stages are done (or aborting): join them bounded
+        io_t.join(_JOIN_S)
+        pack_t.join(_JOIN_S)
+        if self.error is not None:
+            # drain so nothing pins batches/device refs, then take the
+            # fetch worker down too — the load failed as a unit
+            fetch_t.join(_JOIN_S)
+            for q in (self.pack_q, self.disp_q, self.fetch_q):
+                while True:
+                    try:
+                        q.get_nowait()
+                    except queue.Empty:
+                        break
+            if io_t.is_alive() or pack_t.is_alive() or fetch_t.is_alive():
+                raise PipelineError(  # pragma: no cover - defensive
+                    f"pipeline stage '{self.error_stage}' failed and "
+                    "workers did not drain"
+                ) from self.error
+            raise PipelineError(
+                f"bulk load pipeline stage '{self.error_stage}' failed"
+            ) from self.error
+        if io_t.is_alive() or pack_t.is_alive():
+            raise PipelineError(  # pragma: no cover - defensive
+                "pipeline workers did not drain"
+            )
+        return self.memo_hits, self.fallbacks

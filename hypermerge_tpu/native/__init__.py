@@ -98,7 +98,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     # columnar pack entry points are OPTIONAL: a prebuilt .so from an
     # older tree (no compiler to rebuild with) must keep serving crypto
-    # + codec rather than disabling the whole native layer
+    # + codec rather than disabling the whole native layer.
+    #
+    # GIL contract: the library is loaded with ctypes.CDLL (never
+    # PyDLL), so every foreign call — hm_pack_prefix included — RUNS
+    # WITH THE GIL RELEASED for the duration of the C call. The
+    # streaming slab pipeline (backend/pipeline.py) depends on this:
+    # its pack worker thread spends its time inside hm_pack_prefix
+    # while the io thread reads the next slab's sidecars and the
+    # dispatch thread feeds the device. The pack entries touch only
+    # caller-owned buffers (no Python objects, no allocation through
+    # CPython), which is what makes the GIL-free call sound; pinned by
+    # tests/test_native_pack.py::test_pack_releases_gil.
     try:
         ll = ctypes.c_longlong
         lib.hm_pack_value_minmax.restype = ctypes.c_int
@@ -149,6 +160,16 @@ def pack_lib() -> Optional[ctypes.CDLL]:
     if lib is None or not getattr(lib, "_has_pack", False):
         return None
     return lib
+
+
+def pack_drops_gil() -> bool:
+    """True when the pack entry points are bound through a plain
+    ctypes.CDLL, whose foreign calls release the GIL — the property the
+    bulk loader's pipelined pack stage relies on to overlap packing
+    with sidecar IO and device dispatch. (ctypes.PyDLL would hold the
+    GIL; we never load through it.)"""
+    lib = pack_lib()
+    return lib is not None and not isinstance(lib, ctypes.PyDLL)
 
 
 def available() -> bool:

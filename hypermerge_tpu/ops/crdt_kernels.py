@@ -651,18 +651,26 @@ def host_args(batch: ColumnarBatch, lean: bool = False):
 last_args_timings: Dict[str, float] = {}
 
 
-def _device_args(batch: ColumnarBatch, lean: bool = False):
+def _device_args(batch: ColumnarBatch, lean: bool = False, device=None):
     """(device args, A_loc, K) for the jitted kernels. `lean` skips the
-    seq/value builds and uploads (their slots are None)."""
+    seq/value builds and uploads (their slots are None). `device` pins
+    the upload to a specific device (the slab round-robin scheduler);
+    None uses the default placement."""
     import time
 
     _enable_persistent_compile_cache()
     t0 = time.perf_counter()
     np_args, A, K = host_args(batch, lean=lean)
     t1 = time.perf_counter()
-    args = tuple(
-        None if a is None else jnp.asarray(a) for a in np_args
-    )
+    if device is None:
+        args = tuple(
+            None if a is None else jnp.asarray(a) for a in np_args
+        )
+    else:
+        args = tuple(
+            None if a is None else jax.device_put(a, device)
+            for a in np_args
+        )
     t2 = time.perf_counter()
     last_args_timings["narrow"] = t1 - t0
     last_args_timings["upload"] = t2 - t1
@@ -682,13 +690,17 @@ def run_batch(batch: ColumnarBatch) -> MaterializeOut:
     return materialize_device(*args, A=A, K=K)
 
 
-def run_batch_full(batch: ColumnarBatch, lean: bool = False):
+def run_batch_full(
+    batch: ColumnarBatch, lean: bool = False, device=None
+):
     """Host entry -> (MaterializeOut, fused summary wire buffer) in one
     dispatch (decode the wire with parse_summary_wire).
 
     `lean=True` (callers that hold authoritative host clocks and verified
-    the batch carries no INC ops) skips the seq/value wires entirely."""
-    args, A, K = _device_args(batch, lean=lean)
+    the batch carries no INC ops) skips the seq/value wires entirely.
+    `device` pins args (and therefore execution) to one device — the
+    slab round-robin scheduler's per-chip dispatch."""
+    args, A, K = _device_args(batch, lean=lean, device=device)
     if lean:
         (flags, slot, ctr, _seq, obj, key, ref, _value, psrc, ptgt,
          da) = args

@@ -370,15 +370,20 @@ class BulkSummaries:
     per-doc clock already decoded."""
 
     def __init__(self, pending, memo_slabs=None) -> None:
-        # pending: (doc_ids, batch, dec, summary_wire_or_None, lean)
+        # pending: (doc_ids, batch, dec, wire, lean) where wire is the
+        # device summary buffer, None (host-kernel slab), or — when the
+        # streaming pipeline's fetch worker already overlapped the
+        # transfer+parse with later slabs' packs — the parsed arrays
+        # dict itself
         self.slabs: List[Tuple[List[str], Optional[ColumnarBatch], Dict]] = []
         self._where: Dict[str, Tuple[int, int]] = {}
         for doc_ids, batch, dec, wire, lean in pending:
-            arrays = (
-                decode_columnar(dec)
-                if wire is None  # host-kernel slab: no device refs
-                else fetch_summary(wire, batch, lean)
-            )
+            if wire is None:  # host-kernel slab: no device refs
+                arrays = decode_columnar(dec)
+            elif isinstance(wire, dict):  # pre-fetched by the pipeline
+                arrays = wire
+            else:
+                arrays = fetch_summary(wire, batch, lean)
             if dec.host_clocks is not None:
                 # lean slabs never transferred the seq wire (nor the
                 # wire's clock section), so the clock lane is zeros:

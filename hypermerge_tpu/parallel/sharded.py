@@ -16,6 +16,10 @@ The reference processes documents one at a time on one Node thread
   actor per slot per doc) — decode those with `local_clock_union`.
 - `step`: one full "merge step" combining materialize + local clock
   union — what dryrun_multichip exercises end-to-end.
+- `SlabRoundRobin`: the streaming-pipeline alternative to sharded
+  dispatch — whole slabs round-robin across devices with bounded
+  per-device in-flight queues, so chips run independent programs while
+  the host packs ahead (RepoBackend bulk loader, HM_PIPELINE=1).
 """
 
 from __future__ import annotations
@@ -203,6 +207,76 @@ def local_clock_union(clock, doc_actors, n_actors: int, mesh: Mesh):
     )
     with mesh:
         return fn(clock, doc_actors)
+
+
+class SlabRoundRobin:
+    """Round-robin WHOLE slabs across visible devices with bounded
+    per-device in-flight queues — the streaming pipeline's multi-chip
+    dispatch (RepoBackend._dispatch_slab under HM_PIPELINE=1).
+
+    Where `sharded_full` splits one slab across the mesh (dp sharding:
+    one program, every chip in lockstep, the host blocked feeding all
+    chips at once), round-robin keeps each slab whole on one chip and
+    streams successive slabs to successive chips. Chips run independent
+    programs, so while chip k computes slab N the host packs slab N+1
+    for chip k+1 — the 8-chip projection becomes an actual overlapped
+    run instead of an 8x divide of a serial device stage. Same kernels
+    (materialize_full_device / the lean twin), same (A_loc, K) buckets,
+    so results are bit-identical to the single-device and sharded
+    paths.
+
+    Backpressure: at most `depth` (HM_RR_DEPTH, default 2) unfetched
+    slabs per device; dispatching onto a saturated device blocks on its
+    OLDEST outstanding summary, which bounds host staging and device
+    memory to depth x n_devices slabs."""
+
+    def __init__(self, devices=None, depth: int = None) -> None:
+        import os
+
+        self.devices = list(
+            devices if devices is not None else jax.devices()
+        )
+        self.depth = (
+            depth
+            if depth is not None
+            else max(1, int(os.environ.get("HM_RR_DEPTH", "2")))
+        )
+        self._next = 0
+        self._inflight = {i: [] for i in range(len(self.devices))}
+
+    def dispatch(self, batch: ColumnarBatch, lean: bool = False):
+        """(MaterializeOut, summary wire) on the next device in the
+        cycle; blocks only when that device already holds `depth`
+        unfetched slabs. The kernel entry is run_batch_full with a
+        pinned device — the same code path as the single-device twin,
+        so the two cannot diverge."""
+        from ..ops.crdt_kernels import run_batch_full
+
+        i = self._next
+        self._next = (self._next + 1) % len(self.devices)
+        q = self._inflight[i]
+        while len(q) >= self.depth:
+            q.pop(0).block_until_ready()
+        out, summary = run_batch_full(
+            batch, lean=lean, device=self.devices[i]
+        )
+        q.append(summary)
+        return out, summary
+
+    def drain(self) -> None:
+        """Block until every outstanding dispatch has completed."""
+        for q in self._inflight.values():
+            while q:
+                q.pop(0).block_until_ready()
+
+    def release(self) -> None:
+        """Drop the backpressure refs without blocking — called when a
+        bulk load finishes dispatching. The consumers (pending summary
+        entries / the fetch worker) hold their own refs; keeping these
+        would pin depth x n_devices device buffers for the lifetime of
+        the cached scheduler."""
+        for q in self._inflight.values():
+            q.clear()
 
 
 def step(batch: ColumnarBatch, mesh: Mesh):

@@ -325,11 +325,23 @@ class Feed:
         tail found on disk beyond the last record — crash leftovers or
         an attacker's append — must FAIL the audit, never be signed
         into validity."""
+        from .integrity import AUDIT_OK
+
+        return self.audit_status() == AUDIT_OK
+
+    def audit_status(self) -> str:
+        """Three-way audit (storage/integrity.py AUDIT_*): "ok",
+        "unsigned_tail" (a writable feed's crash-orphaned lazy-signing
+        tail — recoverable: seal() signs a fresh head record), or
+        "tampered". In-process unsigned tails are sealed before
+        auditing, exactly as audit() always did."""
+        from .integrity import AUDIT_TAMPERED
+
         if self.integrity is None:
-            return False
+            return AUDIT_TAMPERED  # unverifiable: no sig chain storage
         if self.writable and self.integrity.unsigned_tail:
             self.seal()
-        return self.integrity.audit(self)
+        return self.integrity.audit_status(self)
 
     def _append_raw(self, data: bytes) -> int:
         """Append without writability or signature checks. Only for

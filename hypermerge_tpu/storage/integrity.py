@@ -52,6 +52,15 @@ from ..utils.debug import log
 _SIG_CONTEXT = b"hm-feed-v1"
 _REC = struct.Struct("<Q32s64s")  # length, root, signature
 
+# audit_status() results: OK / recoverable crash-orphan / tampered.
+# Lazy signing (sign_interval) means a crash can legitimately leave a
+# writable feed with blocks beyond its last signed record; that is NOT
+# the same evidence as on-disk tampering, and tooling (tools/ls.py)
+# surfaces it separately with the seal() recovery path.
+AUDIT_OK = "ok"
+AUDIT_UNSIGNED_TAIL = "unsigned_tail"
+AUDIT_TAMPERED = "tampered"
+
 _NODE_PREFIX = b"\x01"
 
 
@@ -424,24 +433,52 @@ class FeedIntegrity:
             self._proof_cache = {}
 
     def audit(self, feed) -> bool:
+        """Strict boolean audit: True only for AUDIT_OK (see
+        audit_status — an unsigned tail is NOT ok, but callers that
+        need to distinguish recoverable-unsigned from tampered must use
+        audit_status; this keeps the historical contract that anything
+        short of a fully verified chain fails)."""
+        return self.audit_status(feed) == AUDIT_OK
+
+    def audit_status(self, feed) -> str:
         """Re-hash the entire block log against EVERY stored record —
-        the newest covers all blocks; intermediate ones are load-bearing
-        for chunked replication serving, so a corrupted record anywhere
-        in the chain fails the audit (pinned by the tamper fuzz).
-        False = blocks or records were tampered with on disk (or the sig
-        chain is missing while blocks exist). Reads the feed and
-        recomputes independently of the cached state — and takes no
-        integrity lock while reading the feed, so a concurrent writer
-        (feed lock -> integrity lock) cannot deadlock against it."""
+        the newest covers the signed prefix; intermediate ones are
+        load-bearing for chunked replication serving, so a corrupted
+        record anywhere in the chain fails the audit (pinned by the
+        tamper fuzz). Reads the feed and recomputes independently of
+        the cached state — and takes no integrity lock while reading
+        the feed, so a concurrent writer (feed lock -> integrity lock)
+        cannot deadlock against it.
+
+        Returns one of:
+        - AUDIT_OK: every block is covered by a verified record chain.
+        - AUDIT_UNSIGNED_TAIL: the signed prefix verifies, but a
+          WRITABLE feed holds blocks beyond its last record — the
+          shape lazy signing leaves after a crash between an append
+          and the periodic record (sign_interval). Distinct from
+          tampering: the tail is locally authored and recoverable —
+          `Feed.seal()` signs a fresh head record and the next audit
+          is clean. (Feed.close() seals tails appended in-process; a
+          crash skips that, hence this status on reopen.)
+        - AUDIT_TAMPERED: blocks or records fail verification, records
+          claim blocks the log no longer holds, or a READ-ONLY feed
+          carries uncovered blocks (a foreign tail must never audit as
+          recoverable — we cannot distinguish it from an attacker's
+          append, and must not sign it into validity)."""
         recs = self.records()
+        n_blocks = feed.length
         if not recs:
-            return feed.length == 0
+            if n_blocks == 0:
+                return AUDIT_OK
+            # blocks but no chain at all: an interrupted writable feed
+            # that never reached its first sign_interval, or a foreign/
+            # unverifiable log
+            return (
+                AUDIT_UNSIGNED_TAIL if feed.writable else AUDIT_TAMPERED
+            )
         last_len = recs[-1][0]
-        if last_len != feed.length:
-            # records claim more than the log holds, OR the log holds
-            # blocks no record covers (crash leftovers / foreign
-            # appends under lazy signing) — either way unverifiable
-            return False
+        if last_len > n_blocks:
+            return AUDIT_TAMPERED  # records claim blocks the log lost
         wanted = {length for length, _r, _s in recs}
         blocks = feed.get_batch(0, last_len)
         peaks = Peaks()
@@ -453,10 +490,24 @@ class FeedIntegrity:
         pub = keymod.decode(self.public_key)
         for length, root, sig in recs:
             if roots.get(length) != root:
-                return False
+                return AUDIT_TAMPERED
             if not crypto.verify(signable(length, root), sig, pub):
-                return False
-        return True
+                return AUDIT_TAMPERED
+        if last_len < n_blocks:
+            # signed prefix intact, tail uncovered: crash-orphaned
+            # unsigned tail on a writable feed (recoverable via seal);
+            # on a read-only feed, indistinguishable from a foreign
+            # append — fail hard
+            if feed.writable:
+                log(
+                    "repo:integrity",
+                    f"feed {self.public_key[:6]}: unsigned tail beyond "
+                    f"last record ({n_blocks - last_len} block(s) past "
+                    f"{last_len}) — seal() re-signs the head",
+                )
+                return AUDIT_UNSIGNED_TAIL
+            return AUDIT_TAMPERED
+        return AUDIT_OK
 
 
 def _peak_sizes(length: int) -> List[int]:

@@ -218,6 +218,32 @@ class CorpusSlab:
         self._mm_size = size
         return self._mm
 
+    def prefetch(self, names) -> None:
+        """Read-ahead hint for the streaming pipeline's io stage: ask
+        the OS (madvise WILLNEED) to page in the live extents of
+        `names` before image_bytes slices them, so a cold-cache bulk
+        open's reads are sequential prefetches instead of per-feed
+        demand faults. Advisory only — unknown names and platforms
+        without madvise are silently fine."""
+        with self._lock:
+            self._ensure_loaded()
+            mm = self._mapped()
+            if mm is None or not hasattr(mm, "madvise"):
+                return
+            page = mmap.PAGESIZE
+            for name in names:
+                for _k, off, ln in self._feeds.get(name, ()):
+                    start = off - (off % page)
+                    try:
+                        mm.madvise(
+                            mmap.MADV_WILLNEED, start, off + ln - start
+                        )
+                    except (OSError, ValueError):
+                        # advisory only: a transient per-extent failure
+                        # (ENOMEM/EAGAIN) must not abandon the hints
+                        # for the rest of the chunk
+                        continue
+
     def image_bytes(self, name: str) -> bytes:
         """The feed's sidecar image in FileColumnStorageV2 byte format:
         live image segment + record segments, concatenated. One mmap

@@ -39,6 +39,38 @@ def run():
         f"open_many+summaries: {dt:.2f}s -> {n_docs*n_ops/dt:,.0f} ops/s "
         f"({len(handles)} handles, {len(summaries.doc_ids)} summarized)"
     )
+    _stage_timeline(repo.back.last_bulk_stats, dt)
+
+
+def _stage_timeline(stats, wall):
+    """Per-stage concurrency table: busy seconds vs the overlapped wall
+    clock. Under HM_PIPELINE=1 the stages run concurrently, so their
+    busy times sum past the wall critical path; the concurrency factor
+    is how much of the pipeline's overlap actually materialized
+    (1.0x = fully serial)."""
+    pipelined = bool(stats.get("pipeline", 0))
+    # pipeline mode: the barrier's t_fetch is residual WAITING on the
+    # fetch worker's t_fetch_busy work — only the busy time counts
+    keys = (
+        "t_sql", "t_io", "t_spec", "t_pack", "t_narrow", "t_upload",
+        "t_dispatch",
+    ) + (("t_fetch_busy",) if pipelined else ("t_fetch",))
+    mode = "busy (overlapped)" if pipelined else "wall (serial)"
+    print(f"stage timeline [{mode}]:")
+    busy_total = 0.0
+    for k in keys:
+        v = stats.get(k)
+        if not v:
+            continue
+        busy_total += v
+        bar = "#" * max(1, int(40 * v / max(wall, 1e-9)))
+        print(f"  {k:<13} {v:7.3f}s |{bar}")
+    cp = stats.get("wall_critical_path", wall)
+    print(
+        f"  wall critical path {cp:.3f}s, stage busy total "
+        f"{busy_total:.3f}s -> {busy_total / max(cp, 1e-9):.2f}x "
+        "concurrency"
+    )
 
 
 if "--cprofile" in sys.argv:

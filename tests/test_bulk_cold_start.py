@@ -30,6 +30,16 @@ from helpers import Site, plainify, random_mutation, sync, wait_until
 INF = float("inf")
 
 
+@pytest.fixture(params=["0", "1"], ids=["serial", "pipeline"])
+def pipeline_mode(request, monkeypatch):
+    """Env-matrix: every bulk cold-start test runs under BOTH the
+    serial twin (HM_PIPELINE=0) and the streaming slab pipeline
+    (HM_PIPELINE=1, the product default) — the pipeline is a pure
+    scheduling change and must pass the identical contract."""
+    monkeypatch.setenv("HM_PIPELINE", request.param)
+    return request.param
+
+
 def _history(seed: int, n_actors: int = 3, n_mut: int = 40):
     r = random.Random(seed)
     sites = [Site(f"actor{i:02d}") for i in range(n_actors)]
@@ -238,7 +248,7 @@ def test_colcache_corrupt_block_clamps_prefix():
     assert fc.changes_in_window(0, INF) == cut
 
 
-def test_bulk_load_is_lazy_then_reconstructs():
+def test_bulk_load_is_lazy_then_reconstructs(pipeline_mode):
     """After load_documents_bulk, docs serve clock/snapshot without a
     host OpSet; the first incremental change reconstructs it exactly."""
     with tempfile.TemporaryDirectory() as tmp:
@@ -280,7 +290,7 @@ def test_bulk_load_is_lazy_then_reconstructs():
         repo2.close()
 
 
-def test_bulk_loaded_doc_applies_replicated_changes():
+def test_bulk_loaded_doc_applies_replicated_changes(pipeline_mode):
     """A replicated block arriving after a bulk (lazy) load must reach
     the doc — the sync path reconstructs the OpSet on demand."""
     from hypermerge_tpu.crdt.change import Action, Change, Op, ROOT
@@ -321,7 +331,7 @@ def test_bulk_loaded_doc_applies_replicated_changes():
         repo2.close()
 
 
-def test_bulk_load_slabs_split_dispatches():
+def test_bulk_load_slabs_split_dispatches(pipeline_mode):
     with tempfile.TemporaryDirectory() as tmp:
         repo = Repo(path=tmp)
         urls = [repo.create({"i": i}) for i in range(5)]
@@ -334,7 +344,7 @@ def test_bulk_load_slabs_split_dispatches():
         repo2.close()
 
 
-def test_mixed_contiguity_bulk_load_stays_fast(tmp_path):
+def test_mixed_contiguity_bulk_load_stays_fast(tmp_path, pipeline_mode):
     """One gap-y doc in a 1000-doc bulk load must NOT drag the other 999
     onto the per-op host replay path — and the fallback count is
     surfaced (VERDICT r3 weak #4 / next-round item 7)."""
@@ -382,7 +392,7 @@ def test_mixed_contiguity_bulk_load_stays_fast(tmp_path):
     repo2.close()
 
 
-def test_actor_columns_rebuild_from_blocks(tmp_path):
+def test_actor_columns_rebuild_from_blocks(tmp_path, pipeline_mode):
     """A feed written without a sidecar (or with a deleted one) rebuilds
     its columns from blocks on first access."""
     import shutil
@@ -411,7 +421,7 @@ def test_actor_columns_rebuild_from_blocks(tmp_path):
         repo2.close()
 
 
-def test_counter_docs_survive_bulk_and_fast_reopen(tmp_path, monkeypatch):
+def test_counter_docs_survive_bulk_and_fast_reopen(tmp_path, monkeypatch, pipeline_mode):
     """INC ops (counters) force the non-lean kernel path; both the bulk
     and single-doc fast opens must materialize accumulated totals."""
     from hypermerge_tpu.models import Counter
@@ -472,7 +482,7 @@ def test_fast_open_uses_sidecar_not_replay():
         repo2.close()
 
 
-def test_interactive_churn_during_bulk_load(tmp_path):
+def test_interactive_churn_during_bulk_load(tmp_path, pipeline_mode):
     """Interactive creates/changes racing a bulk cold open must not
     deadlock (bulk mutex) or lose work (deferred actor syncs)."""
     import threading
@@ -510,7 +520,7 @@ def test_interactive_churn_during_bulk_load(tmp_path):
     repo.close()
 
 
-def test_open_many_lazy_handles():
+def test_open_many_lazy_handles(pipeline_mode):
     """open_many: one bulk backend load, snapshots decoded only when a
     handle is actually read; change() on a lazy handle materializes
     first."""

@@ -91,6 +91,89 @@ class TestWriterSigning:
         assert f2.audit()
         feeds2.close()
 
+    def test_crash_orphaned_unsigned_tail_distinct_status(self, tmp_path):
+        """Lazy signing + crash: a WRITABLE feed reopened with blocks
+        beyond its last signed record must report the distinct
+        "unsigned_tail" status (recoverable via seal()), not the
+        tamper-indistinguishable False/"tampered" — while audit()'s
+        strict boolean contract stays False until sealed."""
+        from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
+        from hypermerge_tpu.storage.integrity import (
+            AUDIT_OK,
+            AUDIT_TAMPERED,
+            AUDIT_UNSIGNED_TAIL,
+            file_sig_storage_fn,
+        )
+
+        root = str(tmp_path)
+        feeds = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        pair = keymod.create()
+        f = feeds.create(pair)
+        for i in range(5):
+            f.append(f"block{i}".encode())
+        f.integrity.record_for(f, 3)  # signed record below the head
+        # crash: the process never seals — reopen straight from disk
+        feeds2 = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        f2 = feeds2.create(pair)
+        assert f2.integrity.signed_length == 3 and f2.length == 5
+        assert f2.audit_status() == AUDIT_UNSIGNED_TAIL
+        assert f2.audit() is False  # strict boolean stays strict
+        # recovery path: seal() signs a fresh head record
+        f2.seal()
+        assert f2.audit_status() == AUDIT_OK
+        assert f2.audit() is True
+        feeds2.close()
+
+        # a READ-ONLY holder of the same shape cannot distinguish the
+        # tail from a foreign append: must stay "tampered"
+        root2 = str(tmp_path / "ro")
+        feeds3 = FeedStore(
+            file_storage_fn(root2), sig_fn=file_sig_storage_fn(root2)
+        )
+        g = feeds3.create(pair)
+        for i in range(4):
+            g.append(f"ro{i}".encode())
+        g.integrity.record_for(g, 2)
+        feeds4 = FeedStore(
+            file_storage_fn(root2), sig_fn=file_sig_storage_fn(root2)
+        )
+        g2 = feeds4.open_feed(pair.public_key)
+        assert not g2.writable
+        assert g2.audit_status() == AUDIT_TAMPERED
+        assert g2.audit() is False
+        feeds4.close()
+
+    def test_unsigned_tail_with_no_records_at_all(self, tmp_path):
+        """A writable feed that crashed before its FIRST record is the
+        same recoverable shape (whole log is the unsigned tail)."""
+        from hypermerge_tpu.storage.feed import FeedStore, file_storage_fn
+        from hypermerge_tpu.storage.integrity import (
+            AUDIT_OK,
+            AUDIT_UNSIGNED_TAIL,
+            file_sig_storage_fn,
+        )
+
+        root = str(tmp_path)
+        feeds = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        pair = keymod.create()
+        f = feeds.create(pair)
+        f.append(b"only-block")
+        feeds2 = FeedStore(
+            file_storage_fn(root), sig_fn=file_sig_storage_fn(root)
+        )
+        f2 = feeds2.create(pair)
+        assert f2.integrity.signed_length == 0 and f2.length == 1
+        assert f2.audit_status() == AUDIT_UNSIGNED_TAIL
+        f2.seal()
+        assert f2.audit_status() == AUDIT_OK
+        feeds2.close()
+
     def test_on_disk_block_tamper_detected(self, tmp_path):
         repo = Repo(path=str(tmp_path))
         url = repo.create({"x": 1})

@@ -172,6 +172,110 @@ def test_multi_actor_general_path_unchanged(monkeypatch):
 
 
 @needs_pack
+def test_pack_releases_gil(tmp_path, monkeypatch):
+    """The hm_pack_prefix binding must DROP the GIL (ctypes.CDLL
+    foreign-call semantics) — the streaming slab pipeline's pack
+    worker relies on it to overlap packing with sidecar IO. Two
+    checks: (1) a Python thread keeps making progress while packs run
+    (GIL actually released — meaningful even on one core); (2) with
+    >=2 cores, two concurrent packs on DISTINCT output buffers overlap
+    in wall time."""
+    import os
+    import threading
+    import time
+
+    from hypermerge_tpu import native
+    from hypermerge_tpu.ops.synth import synth_changes
+
+    assert native.pack_drops_gil()
+    monkeypatch.setenv("HM_NATIVE_PACK", "1")
+
+    # one sizeable plane-backed feed; packs of 8 whole-prefix windows
+    # of it spend their time inside the native batch entry
+    history = synth_changes(
+        40_000, n_actors=1, ops_per_change=64, text_frac=0.5, seed=9
+    )
+    cc = _plane_cache(tmp_path, "gil", history)
+    fc = cc.columns()
+    assert fc.planes is not None
+
+    def one_pack():
+        specs = [[(fc, 0, INF)] for _ in range(8)]
+        b = pack_docs_columns(specs)
+        assert b.n_rows >= 40_000
+
+    one_pack()  # warm the interner memos / allocator
+
+    # -- (1) GIL-progress: a spinner thread must not starve ------------
+    stop = [False]
+    spins = [0]
+
+    def spinner():
+        while not stop[0]:
+            spins[0] += 1
+
+    t = threading.Thread(target=spinner, daemon=True)
+    t.start()
+    time.sleep(0.02)  # let it settle
+    spins[0] = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.4:
+        one_pack()
+    held_spins = spins[0]
+    stop[0] = True
+    t.join(5)
+    # a GIL-holding native call would leave the spinner almost no
+    # iterations; released, it runs freely (other core) or timeslices
+    assert held_spins > 10_000, (
+        f"spinner starved during native packs ({held_spins} iters): "
+        "is the pack binding holding the GIL?"
+    )
+
+    # -- (2) wall-time overlap of two concurrent packs -----------------
+    if (os.cpu_count() or 1) < 2:
+        cc.close()
+        pytest.skip("single core: wall-time overlap is unmeasurable")
+
+    def packs(n):
+        for _ in range(n):
+            one_pack()
+
+    # min serial vs min concurrent across attempts: unrelated machine
+    # load inflates both, the minima are what the scheduling allows
+    best_serial = best_conc = None
+    for _attempt in range(5):
+        t0 = time.perf_counter()
+        packs(6)
+        serial = time.perf_counter() - t0
+        ts = [
+            threading.Thread(target=packs, args=(3,), daemon=True)
+            for _ in range(2)
+        ]
+        t0 = time.perf_counter()
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join(60)
+        conc = time.perf_counter() - t0
+        best_serial = min(serial, best_serial or serial)
+        best_conc = min(conc, best_conc or conc)
+        if best_conc < 0.9 * best_serial:
+            break
+    cc.close()
+    ratio = best_conc / max(best_serial, 1e-9)
+    if ratio >= 0.9:
+        # the spinner above already PROVED the GIL drops; wall-time
+        # overlap additionally needs a genuinely idle second core,
+        # which a loaded CI box can't promise — don't flake the suite
+        pytest.skip(
+            f"GIL release proven by spinner, but no idle core to show "
+            f"wall overlap (conc/serial={ratio:.2f})"
+        )
+    # reaching here means the overlap was actually observed (< 0.9);
+    # the hard GIL enforcement is the spinner assert above
+
+
+@needs_pack
 def test_counter_and_text_kinds_roundtrip(tmp_path, monkeypatch):
     """INC lanes (dt/ref) and text inserts through both twins, then a
     full device-twin decode to pin semantic equality too."""

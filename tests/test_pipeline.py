@@ -1,0 +1,367 @@
+"""Streaming slab pipeline (backend/pipeline.py): equivalence with the
+serial twin, failure-path hygiene, and round-robin device dispatch.
+
+The pipeline restructures the bulk cold open from sum(stages) to
+~max(stage) by overlapping IO, pack, dispatch, and fetch across slabs
+— but it must be a pure SCHEDULING change: `HM_PIPELINE=1` and
+`HM_PIPELINE=0` must produce byte-identical summary arrays, identical
+summary-memo contents, and identical doc/fast/fallback accounting. A
+stage failure must fail the whole load as a unit: no hung worker
+threads, no pending device refs, queues drained.
+"""
+
+import random
+import shutil
+import threading
+import time
+
+import pytest
+
+from helpers import plainify
+from hypermerge_tpu.backend.pipeline import PipelineError
+from hypermerge_tpu.models import Counter, Text
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.utils.ids import validate_doc_url
+
+
+def _make_corpus(path, n_docs=14, seed=7):
+    """Single-writer docs of varied size/shape (maps, text, counters)
+    so slabs bucket at different [D, N] shapes and every value lane is
+    exercised."""
+    r = random.Random(seed)
+    repo = Repo(path=str(path))
+    urls = []
+    for i in range(n_docs):
+        u = repo.create({"i": i, "t": Text(f"doc{i}:"), "hits": Counter(0)})
+        for k in range(r.randrange(1, 9)):
+            kind = r.randrange(3)
+            if kind == 0:
+                repo.change(
+                    u, lambda d, k=k: d.__setitem__(f"k{k}", k * 3)
+                )
+            elif kind == 1:
+                repo.change(
+                    u, lambda d, k=k: d["t"].insert(0, f"<{k}>")
+                )
+            else:
+                repo.change(u, lambda d: d.increment("hits", 2))
+        urls.append(u)
+    want = {u: plainify(repo.doc(u)) for u in urls}
+    repo.close()
+    return urls, want
+
+
+def _add_gap_doc(path):
+    """One doc with a seq gap in its feed: must fall back to host
+    replay in BOTH modes (fallback accounting equivalence)."""
+    from hypermerge_tpu.crdt.change import Action, Change, Op, ROOT
+    from hypermerge_tpu.storage import block as blockmod
+
+    repo = Repo(path=str(path))
+    url = repo.create({"gap": True})
+    doc_id = validate_doc_url(url)
+    actor = repo.back.actors[doc_id]
+    head = actor.seq_head
+    max_op = max(
+        c.max_op for c in actor.changes_in_window(0, float("inf"))
+    )
+    actor.feed._append_raw(
+        blockmod.pack(
+            Change(
+                actor=doc_id,
+                seq=head + 2,  # head+1 never written
+                start_op=max_op + 1,
+                deps={},
+                ops=(Op(action=Action.SET, obj=ROOT, key="late", value=1),),
+            ).to_json()
+        )
+    )
+    repo.close()
+    return url
+
+
+def _doc_summary_bytes(summ, doc_id):
+    arrays, j = summ.arrays(doc_id)
+    out = {
+        k: arrays[k][j].tobytes()
+        for k in ("map_winner", "elem_live", "elem_order")
+    }
+    out["n_live"] = int(arrays["n_live_elems"][j])
+    out["n_map"] = int(arrays["n_map_entries"][j])
+    out["clock"] = summ.doc(doc_id)["clock"]
+    return out
+
+
+def _memo_snapshot(back):
+    out = {}
+    for doc_id, m in back._summary_memo.items():
+        out[doc_id] = {
+            "clock": dict(m["clock"]),
+            "N": m["N"],
+            "n_live": m["n_live"],
+            "n_map": m["n_map"],
+            "mw_bits": m["mw_bits"].tobytes(),
+            "el_bits": m["el_bits"].tobytes(),
+            "order": m["order"].tobytes(),
+            "clock_row": m["clock_row"].tobytes(),
+        }
+    return out
+
+
+def _load_twice(path, ids, mode, monkeypatch, slab):
+    """Two bulk loads in one backend (the second is all memo hits);
+    returns per-doc summary bytes for both, the memo snapshot, and the
+    stats of each load."""
+    monkeypatch.setenv("HM_PIPELINE", mode)
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")  # force device path
+    repo = Repo(path=str(path))
+    back = repo.back
+    back.load_documents_bulk(ids, slab=slab)
+    stats1 = dict(back.last_bulk_stats)
+    s1 = back.fetch_bulk_summaries()
+    first = {d: _doc_summary_bytes(s1, d) for d in s1.doc_ids}
+    memo = _memo_snapshot(back)
+    for doc_id in ids:
+        back.close_doc(doc_id)
+    back.load_documents_bulk(ids, slab=slab)
+    stats2 = dict(back.last_bulk_stats)
+    s2 = back.fetch_bulk_summaries()
+    second = {d: _doc_summary_bytes(s2, d) for d in s2.doc_ids}
+    repo.close()
+    counts = [
+        {k: st[k] for k in ("docs", "fast", "memo", "fallback")}
+        for st in (stats1, stats2)
+    ]
+    return first, second, memo, counts
+
+
+def test_pipeline_serial_equivalence_fuzz(tmp_path, monkeypatch):
+    """Fuzzed docs across >=3 slab boundaries: HM_PIPELINE=1 and =0
+    produce byte-identical summary arrays, identical memo contents, and
+    identical doc/fast/fallback counts — on the first (packed +
+    dispatched) AND second (memo-served) loads."""
+    src = tmp_path / "src"
+    urls, want = _make_corpus(src, n_docs=14)
+    gap_url = _add_gap_doc(src)
+    ids = [validate_doc_url(u) for u in urls] + [validate_doc_url(gap_url)]
+
+    results = {}
+    for mode in ("0", "1"):
+        copy = tmp_path / f"repo{mode}"
+        shutil.copytree(src, copy)
+        results[mode] = _load_twice(
+            copy, ids, mode, monkeypatch, slab=4
+        )  # 14 fast docs / slab 4 -> 4 slabs (3+ boundaries)
+
+    first0, second0, memo0, counts0 = results["0"]
+    first1, second1, memo1, counts1 = results["1"]
+    assert counts0 == counts1
+    assert counts0[0]["fallback"] == 1
+    assert counts0[1]["memo"] == counts0[1]["fast"]  # 2nd load: all memo
+    assert set(first0) == set(first1) and len(first0) == 14
+    for d in first0:
+        assert first0[d] == first1[d], f"first-load summary differs: {d}"
+    for d in second0:
+        assert second0[d] == second1[d], f"memo-load summary differs: {d}"
+    assert memo0 == memo1
+
+
+def test_pipeline_matches_interactive_state(tmp_path, monkeypatch):
+    """Pipelined bulk loads materialize the same doc values the writer
+    saw (end-to-end through handles, not just summary arrays)."""
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    urls, want = _make_corpus(tmp_path / "r", n_docs=9, seed=3)
+    repo = Repo(path=str(tmp_path / "r"))
+    ids = [validate_doc_url(u) for u in urls]
+    repo.back.load_documents_bulk(ids, slab=2)
+    summ = repo.back.fetch_bulk_summaries()
+    assert len(summ.doc_ids) == 9
+    for u in urls:
+        assert plainify(repo.doc(u)) == want[u]
+    repo.close()
+
+
+def _assert_pipe_threads_drained(deadline_s=10.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        alive = [
+            t.name
+            for t in threading.enumerate()
+            if t.name.startswith("hm-pipe-")
+        ]
+        if not alive:
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"pipeline workers leaked: {alive}")
+
+
+def _call_with_timeout(fn, timeout_s=90.0):
+    """Run fn on a worker and re-raise its outcome; a hang fails the
+    test instead of wedging the whole suite."""
+    box = {}
+
+    def runner():
+        try:
+            box["ret"] = fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            box["exc"] = e
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    assert not t.is_alive(), "bulk load hung"
+    if "exc" in box:
+        raise box["exc"]
+    return box.get("ret")
+
+
+def test_pipeline_pack_failure_fails_load_cleanly(tmp_path, monkeypatch):
+    """A slab whose pack raises must fail the bulk load as a unit: the
+    error propagates, every worker drains, and no device refs linger in
+    the pending list."""
+    import hypermerge_tpu.ops.columnar as columnar
+
+    urls, _want = _make_corpus(tmp_path / "r", n_docs=12, seed=11)
+    ids = [validate_doc_url(u) for u in urls]
+    monkeypatch.setenv("HM_PIPELINE", "1")
+
+    real = columnar.pack_docs_columns
+    calls = {"n": 0}
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("boom-pack")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(columnar, "pack_docs_columns", boom)
+    repo = Repo(path=str(tmp_path / "r"))
+    with pytest.raises(PipelineError) as ei:
+        _call_with_timeout(
+            lambda: repo.back.load_documents_bulk(ids, slab=4)
+        )
+    assert "boom-pack" in repr(ei.value.__cause__)
+    _assert_pipe_threads_drained()
+    assert repo.back._pending_summaries == []
+    assert repo.back._fetch_ctx is None
+    repo.close()
+
+    # the corpus itself is intact: a fresh backend loads it fine
+    monkeypatch.setattr(columnar, "pack_docs_columns", real)
+    repo2 = Repo(path=str(tmp_path / "r"))
+    repo2.back.load_documents_bulk(ids, slab=4)
+    summ = repo2.back.fetch_bulk_summaries()
+    assert len(summ.doc_ids) == 12
+    repo2.close()
+
+
+def test_pipeline_fetch_failure_fails_cleanly(tmp_path, monkeypatch):
+    """A slab whose summary fetch raises must surface the error (at the
+    load or at the barrier, wherever the overlap window puts it) and
+    leave no hung workers or pending refs."""
+    from hypermerge_tpu.backend.repo_backend import RepoBackend
+
+    urls, _want = _make_corpus(tmp_path / "r", n_docs=10, seed=13)
+    ids = [validate_doc_url(u) for u in urls]
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")  # real device fetches
+
+    real = RepoBackend._fetch_slab
+    calls = {"n": 0}
+
+    def boom(self, entry):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("boom-fetch")
+        return real(self, entry)
+
+    monkeypatch.setattr(RepoBackend, "_fetch_slab", boom)
+    repo = Repo(path=str(tmp_path / "r"))
+
+    def load_and_barrier():
+        repo.back.load_documents_bulk(ids, slab=4)
+        repo.back.fetch_bulk_summaries()
+
+    with pytest.raises(PipelineError) as ei:
+        _call_with_timeout(load_and_barrier)
+    assert "boom-fetch" in repr(ei.value.__cause__)
+    _assert_pipe_threads_drained()
+    assert repo.back._pending_summaries == []
+    assert repo.back._fetch_ctx is None
+    repo.close()
+
+
+def test_round_robin_slabs_across_devices(tmp_path, monkeypatch):
+    """With >1 visible device and the pipeline on, successive slabs
+    land whole on successive devices (rr_slabs accounting), with
+    results identical to the interactive state."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >1 (virtual) device")
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")
+    urls, want = _make_corpus(tmp_path / "r", n_docs=6, seed=5)
+    repo = Repo(path=str(tmp_path / "r"))
+    ids = [validate_doc_url(u) for u in urls]
+    repo.back.load_documents_bulk(ids, slab=2)
+    summ = repo.back.fetch_bulk_summaries()
+    stats = repo.back.last_bulk_stats
+    assert stats.get("rr_slabs") == 3, stats
+    assert stats.get("rr_devices") == len(jax.devices()), stats
+    assert stats.get("sharded_slabs") is None
+    assert len(summ.doc_ids) == 6
+    for u in urls:
+        assert plainify(repo.doc(u)) == want[u]
+    repo.close()
+
+
+def test_slab_round_robin_cycles_and_bounds_inflight():
+    """Unit: the scheduler cycles devices and never holds more than
+    `depth` unfetched summaries per device."""
+    import jax
+    import numpy as np
+
+    from hypermerge_tpu.ops.columnar import pack_docs
+    from hypermerge_tpu.ops.materialize import fetch_summary
+    from hypermerge_tpu.ops.synth import synth_changes
+    from hypermerge_tpu.parallel.sharded import SlabRoundRobin
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        pytest.skip("needs >1 (virtual) device")
+    rr = SlabRoundRobin(devices[:2], depth=1)
+    batches = [
+        pack_docs([synth_changes(48, n_actors=1, ops_per_change=8, seed=s)])
+        for s in range(5)
+    ]
+    wires = []
+    for b in batches:
+        _out, wire = rr.dispatch(b, lean=False)
+        wires.append((b, wire))
+        for q in rr._inflight.values():
+            assert len(q) <= 1
+    assert rr._next == 5 % 2
+    rr.drain()
+    # every slab decodes (placement did not corrupt anything)
+    for b, wire in wires:
+        arrays = fetch_summary(wire, b, lean=False)
+        assert int(np.asarray(arrays["n_map_entries"][0])) >= 0
+
+
+def test_pipeline_stats_report_busy_and_critical_path(tmp_path, monkeypatch):
+    """Pipeline mode reports per-stage busy time (t_*_busy) and the
+    overlapped wall critical path alongside the canonical keys."""
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    urls, _want = _make_corpus(tmp_path / "r", n_docs=5, seed=2)
+    repo = Repo(path=str(tmp_path / "r"))
+    ids = [validate_doc_url(u) for u in urls]
+    repo.back.load_documents_bulk(ids, slab=2)
+    repo.back.fetch_bulk_summaries()
+    stats = repo.back.last_bulk_stats
+    assert stats["pipeline"] == 1
+    for k in ("t_io_busy", "t_pack_busy", "t_dispatch_busy"):
+        assert k in stats
+    assert stats["wall_critical_path"] >= 0.0
+    assert "t_fetch" in stats and "t_fetch_busy" in stats
+    repo.close()

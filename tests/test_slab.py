@@ -49,6 +49,27 @@ def _fill(tmp_path, names=("feedA", "feedB"), seed=1):
     return want
 
 
+def test_slab_prefetch_is_advisory_and_safe(tmp_path):
+    """prefetch() (the pipeline io stage's read-ahead hint) must be a
+    pure no-op semantically: unknown names, empty slabs, and platforms
+    without madvise all pass through; reads after a hint are
+    byte-identical."""
+    want = _fill(tmp_path)
+    fn = file_column_storage_fn(str(tmp_path))
+    slab = fn.slab
+    assert slab is not None
+    slab.prefetch(list(want) + ["no-such-feed"])
+    for name, rows in want.items():
+        cc = FeedColumnCache(fn(name), writer="actor00")
+        assert np.array_equal(cc.columns().ensure_rows(), rows)
+        cc.close()
+    slab.close()
+    # empty slab: nothing mapped, still fine
+    empty = CorpusSlab(str(tmp_path / "none" / "cols.slab"))
+    empty.prefetch(["whatever"])
+    empty.close()
+
+
 def test_slab_roundtrip_and_single_file(tmp_path):
     want = _fill(tmp_path)
     assert os.path.exists(tmp_path / "cols.slab")

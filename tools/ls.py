@@ -5,7 +5,15 @@ this is the inventory one.)
     python tools/ls.py /path/to/repo [--audit]
 
 --audit additionally re-hashes each feed against its signed merkle
-records (storage/integrity.py) and flags tampering.
+records (storage/integrity.py) and flags tampering. A writable feed
+whose process crashed between an append and the periodic signature
+(lazy signing, HM_SIGN_INTERVAL) shows the distinct UNSIGNED-TAIL
+status instead of TAMPERED: the signed prefix verifies and the tail is
+locally authored — recoverable by sealing (any open of the repo that
+appends, or `Feed.seal()`, signs a fresh head record; the next audit
+is clean). TAMPERED is reserved for evidence that cannot be explained
+by a crash: hash/signature mismatches, records covering blocks the log
+lost, or uncovered blocks on a read-only feed.
 """
 
 import argparse
@@ -16,6 +24,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
 from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.storage.integrity import (  # noqa: E402
+    AUDIT_TAMPERED,
+    AUDIT_UNSIGNED_TAIL,
+)
 from hypermerge_tpu.utils.ids import to_doc_url  # noqa: E402
 
 
@@ -57,10 +69,19 @@ def main() -> None:
             f"changes={total_changes} bytes={nbytes}"
         )
         if args.audit:
-            # audit() is True for a genuinely empty feed and False when
-            # records claim blocks the log no longer holds
-            ok = all(back.feeds.open_feed(a).audit() for a in cursor)
-            line += "  integrity=OK" if ok else "  integrity=TAMPERED"
+            # three-way status: OK / UNSIGNED-TAIL (crash-orphaned
+            # lazy-signing tail, recoverable via seal()) / TAMPERED
+            statuses = {
+                back.feeds.open_feed(a).audit_status() for a in cursor
+            }
+            if AUDIT_TAMPERED in statuses:
+                line += "  integrity=TAMPERED"
+            elif AUDIT_UNSIGNED_TAIL in statuses:
+                line += (
+                    "  integrity=UNSIGNED-TAIL (seal() to re-sign)"
+                )
+            else:
+                line += "  integrity=OK"
         print(line)
     repo.close()
 
