@@ -106,6 +106,24 @@ def _config2_convergence(n_docs=10, n_edits=50):
         sb.destroy()
 
 
+def _live_stats(*repos):
+    """Aggregated live-apply engine stats across repos (zeros when the
+    engine is off): ticks, docs/tick, coalesced changes, t_live_*."""
+    out = {}
+    for r in repos:
+        eng = getattr(r.back, "live", None)
+        if eng is None:
+            continue
+        for k, v in eng.stats.items():
+            out[k] = round(out.get(k, 0) + v, 6)
+    if out.get("ticks"):
+        out["docs_per_tick"] = round(out["tick_docs"] / out["ticks"], 2)
+        out["changes_per_tick"] = round(
+            out["tick_changes"] / out["ticks"], 2
+        )
+    return out
+
+
 def _config2_run(ra, rb, sa, sb, n_docs, n_edits):
     import time as _t
 
@@ -150,7 +168,99 @@ def _config2_run(ra, rb, sa, sb, n_docs, n_edits):
         raise AssertionError("config2: A never saw B's edits")
     dt = _t.perf_counter() - t0
     total_edits = n_docs * want
-    return dt, total_edits / dt
+    return dt, total_edits / dt, _live_stats(ra, rb)
+
+
+def _config6_live_burst(n_ops=8192, n_burst=256):
+    """Live-apply on ONE hot text-trace doc (the single-doc shape of
+    config6, on the LIVE path): a stored n_ops-op doc opens lazily,
+    then a remote burst of n_burst single-op edits applies through the
+    per-tick engine. Reports first-edit latency (the cliff BENCH_r05
+    measured as a full host replay), burst edits/s, and the engine's
+    per-stage tick budget. HM_LIVE=0 turns this into a measurement of
+    the host replay cliff itself."""
+    import tempfile as _tf
+    import time as _t
+
+    from hypermerge_tpu.crdt.frontend_state import FrontendDoc
+    from hypermerge_tpu.crdt.opset import OpSet
+    from hypermerge_tpu.repo import Repo
+
+    tmp = _tf.mkdtemp(prefix="hm_live6")
+    try:
+        repo = Repo(path=tmp)
+        url = repo.create({"t": ""})
+        # seed the stored trace in chunked changes (setup, untimed)
+        from hypermerge_tpu.models import Text
+
+        repo.change(url, lambda d: d.__setitem__("t", Text("seed")))
+        chunk = 64
+        for base in range(0, n_ops, chunk):
+            repo.change(
+                url,
+                lambda d, base=base: d["t"].insert(
+                    len(d["t"]), "x" * chunk
+                ),
+            )
+        from hypermerge_tpu.utils.ids import validate_doc_url
+
+        doc_id = validate_doc_url(url)
+        stored = []
+        back_doc = repo.back.docs[doc_id]
+        for actor_id, end in back_doc.clock.items():
+            actor = repo.back._get_or_create_actor(actor_id)
+            stored.extend(actor.changes_in_window(0, end))
+        repo.close()
+
+        repo2 = Repo(path=tmp)
+        h = repo2.open(url)
+        assert h.value(timeout=60) is not None
+        doc = repo2.back.docs[doc_id]
+        # a synthetic peer continues the doc with single-op edits
+        peer_opset = OpSet()
+        peer_front = FrontendDoc()
+        peer_front.apply_patch(peer_opset.apply_changes(stored))
+        peer = "livepeer00000001"
+        seqs = [0]
+
+        def peer_edit():
+            seqs[0] += 1
+            req, _ = peer_front.change(
+                lambda d: d["t"].insert(len(d["t"]), "!"),
+                peer,
+                seqs[0],
+            )
+            ch, patch = peer_opset.apply_local_request(req)
+            peer_front.apply_patch(patch)
+            return ch
+
+        first = peer_edit()
+        # pre-generate the burst so the timed region measures the
+        # APPLY path (the peer-side OpSet generator is O(doc) per edit
+        # and would otherwise serialize the stream into 1-change ticks)
+        burst = [peer_edit() for _ in range(n_burst)]
+
+        t0 = _t.perf_counter()
+        doc.apply_remote_changes([first])
+        while doc.clock.get(peer, 0) < 1:
+            _t.sleep(0.0005)
+        if repo2.back.live is not None:
+            repo2.back.live.flush_now()
+        first_ms = (_t.perf_counter() - t0) * 1e3
+
+        t0 = _t.perf_counter()
+        for base in range(0, n_burst, 32):  # replication-chunk shaped
+            doc.apply_remote_changes(burst[base : base + 32])
+        while doc.clock.get(peer, 0) < 1 + n_burst:
+            _t.sleep(0.0005)
+        if repo2.back.live is not None:
+            repo2.back.live.flush_now()
+        dt = _t.perf_counter() - t0
+        stats = _live_stats(repo2)
+        repo2.close()
+        return first_ms, n_burst / dt, stats
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _config5_union(n_docs=100_000, n_actors=64, seed=0, dirty=1000):
@@ -489,6 +599,16 @@ def main() -> None:
             f"({cfg2[1]:,.0f} edits/s replicated+applied)",
             file=sys.stderr,
         )
+        if cfg2[2]:
+            print(f"# config2 live-apply: {cfg2[2]}", file=sys.stderr)
+    cfg6l = _soft("config6_live", _config6_live_burst)
+    if cfg6l is not None:
+        print(
+            f"# config6-live single-doc burst: first edit "
+            f"{cfg6l[0]:.0f}ms, burst {cfg6l[1]:,.0f} edits/s "
+            f"(live stats {cfg6l[2]})",
+            file=sys.stderr,
+        )
     cfg3 = _soft("config3", _config3_multiactor)
     if cfg3 is not None:
         print(
@@ -542,6 +662,21 @@ def main() -> None:
                     ),
                     "config2_convergence_s": (
                         round(cfg2[0], 2) if cfg2 is not None else None
+                    ),
+                    "config2_edits_per_s": (
+                        round(cfg2[1]) if cfg2 is not None else None
+                    ),
+                    "config2_live": (
+                        cfg2[2] if cfg2 is not None else None
+                    ),
+                    "config6_live_first_edit_ms": (
+                        round(cfg6l[0], 1) if cfg6l is not None else None
+                    ),
+                    "config6_live_burst_edits_per_s": (
+                        round(cfg6l[1]) if cfg6l is not None else None
+                    ),
+                    "config6_live": (
+                        cfg6l[2] if cfg6l is not None else None
                     ),
                     "config3_multiactor_ops_per_s": (
                         round(cfg3[1]) if cfg3 is not None else None

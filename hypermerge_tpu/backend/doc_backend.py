@@ -26,11 +26,28 @@ class DocBackend:
         doc_id: str,
         notify: Callable[[Dict[str, Any]], None],
         opset: Optional[OpSet] = None,
+        live=None,
     ) -> None:
         self.id = doc_id
         self._notify = notify
         self._lock = threading.RLock()
+        # serializes {compute patch -> push} emission pairs on the host
+        # path, so a Ready snapshot can never be pushed with a patch for
+        # a NEWER state already ahead of it in the frontend queue (a
+        # pending frontend drops pre-Ready patches). Only used when the
+        # live engine is OFF (HM_LIVE=0): with the engine on, the
+        # ENGINE lock is the single emission lock for every path
+        # (_emission_lock) — a second per-doc lock would invert against
+        # it when a frontend callback dispatched under one re-enters
+        # the repo and needs the other. Re-entrant for in-process
+        # frontends whose on_patch synchronously sends the next change.
+        self._emit_lock = threading.RLock()
         self.opset: Optional[OpSet] = opset
+        # live apply engine (backend/live.py): lazy docs' incremental
+        # changes batch through per-tick kernel dispatches instead of
+        # reconstructing a host OpSet. None = host path (HM_LIVE=0).
+        self._live = live
+        self._live_adopted = False
         self.actor_id: Optional[str] = None
         # deferred-init state (bulk cold start, repo_backend
         # load_documents_bulk): readiness/clock/snapshot served without a
@@ -40,6 +57,10 @@ class DocBackend:
         self._lazy_len = 0
         self._snapshot_fn: Optional[Callable[[], Any]] = None
         self._snapshot_cache: Optional[Any] = None
+        # (serving clock, OpSet) memo for the time-travel replay of a
+        # live-adopted doc — scrubbing a history slider must not pay a
+        # full feed replay per step
+        self._replay_cache: Optional[tuple] = None
         self.ready = Queue(f"doc:{doc_id[:6]}:ready")
         self._announced = False
         self.minimum_clock: Optional[clockmod.Clock] = None
@@ -123,11 +144,14 @@ class DocBackend:
         with self._lock:
             if self.opset is not None:
                 return
+            if self._live_adopted:
+                return  # the live engine owns this doc's state
             self.opset = OpSet()
             loader, self._lazy_loader = self._lazy_loader, None
             base_clock, self._lazy_clock = self._lazy_clock, None
             self._snapshot_fn = None
             self._snapshot_cache = None
+            self._replay_cache = None
             if loader is not None:
                 with bench("doc:lazyReplay"):
                     changes = loader()
@@ -164,25 +188,66 @@ class DocBackend:
             )
         self._check_ready()
 
-    def materialize_at(self, n: int):
+    def _replay_opset(self) -> Optional[OpSet]:
+        """An OpSet view for the explicit history / time-travel APIs.
+        Live-adopted docs build a TEMPORARY replay from the feeds (the
+        live engine owns the incremental state; host OpSet
+        reconstruction remains only behind these APIs); other lazy docs
+        install their OpSet as before."""
         with self._lock:
-            if self.opset is None and self._lazy_loader is None:
+            if self.opset is not None:
+                return self.opset
+            if self._live_adopted:
+                loader = self._lazy_loader
+                base_clock = dict(self._lazy_clock or {})
+                cached = self._replay_cache
+                if cached is not None and cached[0] == base_clock:
+                    return cached[1]
+                sub = OpSet()
+                if loader is not None:
+                    with bench("doc:historyReplay"):
+                        sub.apply_changes(
+                            [
+                                c
+                                for c in loader()
+                                if c.seq <= base_clock.get(c.actor, 0)
+                            ]
+                        )
+                self._replay_cache = (base_clock, sub)
+                return sub
+            if self._lazy_loader is None:
                 return None
             self._ensure_opset()
-            return self.opset.materialize_at(n)
+            return self.opset
+
+    def materialize_at(self, n: int):
+        with self._lock:
+            opset = self._replay_opset()
+            if opset is None:
+                return None
+            return opset.materialize_at(n)
 
     def history_patch(self, n: int):
         """Snapshot patch of the first n history changes (time travel;
         reconstructs the OpSet if this doc was bulk-loaded)."""
         with self._lock:
-            if self.opset is None and self._lazy_loader is None:
+            opset = self._replay_opset()
+            if opset is None:
                 return None
-            self._ensure_opset()
             sub = OpSet()
-            sub.apply_changes(self.opset.history[:n])
+            sub.apply_changes(opset.history[:n])
             return sub.snapshot_patch()
 
     def snapshot_patch(self):
+        live = self._live
+        with self._lock:
+            adopted = self._live_adopted
+        if adopted and live is not None:
+            # engine lock ordering is engine -> doc: never call in with
+            # the doc lock held
+            patch = live.snapshot_patch(self)
+            if patch is not None:
+                return patch
         with self._lock:
             if self.opset is not None:
                 return self.opset.snapshot_patch()
@@ -218,34 +283,79 @@ class DocBackend:
         )
         self.ready.push(True)
 
+    def _emission_lock(self):
+        """The lock serializing this doc's host-path {compute patch ->
+        push} pairs. With the live engine on it is the ENGINE lock —
+        the one lock every emission path holds, so a frontend callback
+        dispatched synchronously from a push that re-enters the repo
+        (open/change on this thread) recurses instead of deadlocking
+        against send_ready_atomic or a tick. HM_LIVE=0 (no engine)
+        falls back to the per-doc emit lock."""
+        live = self._live
+        return self._emit_lock if live is None else live.emission_lock
+
     def _handle_local(self, req: ChangeRequest) -> None:
-        with self._lock:
-            if self.opset is None:
-                self._ensure_opset()
-            with bench("doc:applyLocalChange"):
-                try:
-                    change, patch = self.opset.apply_local_request(req)
-                except ValueError as e:
-                    log("doc:back", "rejected local change:", e)
-                    return
-        self._notify(
-            {
-                "type": "LocalPatch",
-                "doc": self,
-                "change": change,
-                "patch": patch,
-            }
-        )
+        live = self._live
+        if live is not None and self.opset is None:
+            # lazy doc on the live path: resolve against the engine's
+            # decoded state — no host OpSet reconstruction. The notify
+            # runs inside the engine lock (emit=) so the echo patch
+            # reaches the frontend queue before any tick's delta on the
+            # post-change state.
+            def emit(change, patch):
+                self._notify(
+                    {
+                        "type": "LocalPatch",
+                        "doc": self,
+                        "change": change,
+                        "patch": patch,
+                    }
+                )
+
+            try:
+                res = live.apply_local(self, req, emit=emit)
+            except ValueError as e:
+                log("doc:back", "rejected local change:", e)
+                return
+            if res is not None:
+                self._check_ready()
+                return
+        with self._emission_lock():
+            with self._lock:
+                if self.opset is None:
+                    self._ensure_opset()
+                with bench("doc:applyLocalChange"):
+                    try:
+                        change, patch = self.opset.apply_local_request(req)
+                    except ValueError as e:
+                        log("doc:back", "rejected local change:", e)
+                        return
+            self._notify(
+                {
+                    "type": "LocalPatch",
+                    "doc": self,
+                    "change": change,
+                    "patch": patch,
+                }
+            )
         self._check_ready()
 
     def _handle_remote(self, changes: List[Change]) -> None:
-        with self._lock:
-            if self.opset is None:
-                self._ensure_opset()
-            with bench("doc:applyRemoteChanges"):
-                patch = self.opset.apply_changes(changes)
-        if self._announced and not patch.is_empty:
-            self._notify(
-                {"type": "RemotePatch", "doc": self, "patch": patch}
-            )
+        live = self._live
+        if live is not None and self.opset is None:
+            # lazy doc on the live path: changes coalesce into the next
+            # tick's batched kernel dispatch (backend/live.py); the
+            # engine emits the RemotePatch + readiness itself
+            if live.submit_remote(self, changes):
+                return
+        with self._emission_lock():
+            with self._lock:
+                if self.opset is None:
+                    self._ensure_opset()
+                with bench("doc:applyRemoteChanges"):
+                    patch = self.opset.apply_changes(changes)
+            if self._announced and not patch.is_empty:
+                self._notify(
+                    {"type": "RemotePatch", "doc": self, "patch": patch}
+                )
         self._check_ready()

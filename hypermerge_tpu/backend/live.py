@@ -1,0 +1,1140 @@
+"""Live apply engine — incremental changes as per-tick device batches.
+
+The reference applies every incoming change through the pure-Python
+CRDT backend, one doc at a time — and a bulk-loaded doc first pays a
+FULL host replay of its history the moment one live edit arrives
+(DocBackend._ensure_opset). This module routes the live path through
+the same batching argument the cold open already won: each hot doc's
+packed columnar op history stays cached host-side (ops/columnar.py
+LiveColumns — appendable, no feed IO, no repack), and a short tick
+coalesces all dirty docs' newly arrived changes into ONE padded,
+shape-bucketed, vmapped kernel dispatch (ops/crdt_kernels.py
+materialize_live_device, or its numpy twin below the device-min-cells
+threshold). A burst of N edits across M docs costs O(ticks) device
+programs, not O(N) Python replays.
+
+Twin semantics (HM_LIVE=0 keeps the host-OpSet path):
+- causal admission (seq continuity + deps) mirrors OpSet's pending set
+  change-for-change, so clocks are bit-identical;
+- local changes resolve intents against the engine's decoded state and
+  emit patches bit-identical to OpSet.apply_local_request (a local op
+  always wins: its lamport counter is the doc maximum);
+- remote changes surface as ONE state-delta patch per tick per doc —
+  the same final frontend state as the host path's per-window patches
+  (per-op intermediate diffs are coalesced away), pinned by the fuzz
+  twin test (tests/test_live.py);
+- snapshot patches (Ready, reopen) diff the decoded state against an
+  empty doc and are bit-identical to OpSet.snapshot_patch.
+
+Host OpSet reconstruction remains only behind the explicit history /
+time-travel APIs (DocBackend.materialize_at / history_patch).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..crdt.change import (
+    HEAD,
+    OBJ_TYPE_BY_MAKE,
+    ROOT,
+    Action,
+    Change,
+    ChangeRequest,
+    Op,
+    OpId,
+)
+from ..crdt.patch import Conflict, Diff, Patch
+from ..ops.columnar import LiveColumns
+from ..utils.debounce import Debouncer
+from ..utils.debug import log
+
+ROOT_ID = "0@_root"
+
+
+def _tick_window_s() -> float:
+    return float(os.environ.get("HM_LIVE_TICK_MS", "2")) / 1e3
+
+
+def _tick_window_max_s() -> float:
+    return float(os.environ.get("HM_LIVE_TICK_MAX_MS", "25")) / 1e3
+
+
+def _device_min_cells() -> int:
+    return int(os.environ.get("HM_DEVICE_MIN_CELLS", "131072"))
+
+
+def _inc_budget_cells() -> int:
+    """Incremental-vs-kernel crossover for one doc's tick: apply
+    directly when tick_ops x doc_rows stays under this (the per-op
+    live-index scans cost O(rows); the kernel's vectorized rebuild has
+    a fixed overhead that only amortizes on big catch-ups)."""
+    return int(os.environ.get("HM_LIVE_INC_BUDGET", "2000000"))
+
+
+# ---------------------------------------------------------------------------
+# decoded doc state (OpId space — stable across repacks/ticks)
+
+
+class _Val:
+    """One visible value op at a location."""
+
+    __slots__ = ("base", "link", "datatype")
+
+    def __init__(self, base, link, datatype) -> None:
+        self.base = base
+        self.link = link
+        self.datatype = datatype
+
+
+class _Obj:
+    __slots__ = ("type", "fields", "order")
+
+    def __init__(self, type_: str) -> None:
+        self.type = type_
+        # map/table: key -> {OpId: _Val}; list/text: elem OpId -> {...}
+        # (an elem whose dict is empty is a TOMBSTONE — it stays in
+        # `order` and `fields`, exactly like OpSet, because remote RGA
+        # inserts may reference it and the skip-scan walks it)
+        self.fields: Dict[Any, Dict[OpId, _Val]] = {}
+        self.order: List[OpId] = []  # ALL elems in RGA order
+
+    @property
+    def is_sequence(self) -> bool:
+        return self.type in ("list", "text")
+
+    def live(self) -> List[OpId]:
+        return [e for e in self.order if self.fields.get(e)]
+
+
+class _DocState:
+    __slots__ = ("objs", "inc", "reachable")
+
+    def __init__(self) -> None:
+        self.objs: Dict[OpId, _Obj] = {ROOT: _Obj("map")}
+        self.inc: Dict[OpId, int] = {}
+        # objects whose CURRENT contents the frontend holds (emitted as
+        # winner links). An object re-attached after mutating while
+        # detached re-emits create + full contents (create resets the
+        # frontend's copy), keeping frontends self-healing.
+        self.reachable: Set[OpId] = set()
+
+
+def _op_value(state: _DocState, opid: OpId, val: _Val):
+    """(display value, link, datatype) — OpSet._op_value twin."""
+    if val.link:
+        return str(opid), True, None
+    if val.datatype == "counter":
+        base = val.base or 0
+        return base + state.inc.get(opid, 0), False, "counter"
+    return val.base, False, val.datatype
+
+
+def _conflicts(state: _DocState, cell: Dict[OpId, _Val], winner: OpId):
+    return tuple(
+        Conflict(str(oid), *_op_value(state, oid, cell[oid]))
+        for oid in sorted(cell, reverse=True)
+        if oid != winner
+    )
+
+
+def _display(state: _DocState, cell: Dict[OpId, _Val]):
+    """(winner, value, link, datatype, conflicts) for a visible set."""
+    winner = max(cell)
+    value, link, datatype = _op_value(state, winner, cell[winner])
+    return winner, value, link, datatype, _conflicts(state, cell, winner)
+
+
+# ---------------------------------------------------------------------------
+# state decode from kernel lanes
+
+
+def _decode_state(lv: LiveColumns, lanes) -> _DocState:
+    """Rebuild the decoded doc state from one kernel run over `lv`'s
+    rows (visible/elem_live/rank/inc_total lanes, [n])."""
+    n = lv.n
+    state = _DocState()
+    if n == 0:
+        return state
+    c = lv.cols
+    action = c["action"][:n]
+    opids = lv.opids
+    obj_col = c["obj"][:n]
+    key_col = c["key"][:n]
+    ref_col = c["ref"][:n]
+    insert_col = c["insert"][:n]
+    dt_col = c["dt"][:n]
+    visible = lanes.visible[:n]
+    rank = lanes.rank[:n]
+    inc_total = lanes.inc_total[:n]
+
+    # objects (dead MAKEs included — OpSet retains them)
+    objs = state.objs
+    for r in np.nonzero(action <= 3)[0].tolist():
+        objs[opids[r]] = _Obj(OBJ_TYPE_BY_MAKE[Action(int(action[r]))])
+
+    for r in np.nonzero(inc_total != 0)[0].tolist():
+        state.inc[opids[r]] = int(inc_total[r])
+
+    def val_of(r: int) -> _Val:
+        a = int(action[r])
+        if a <= 3:
+            return _Val(None, True, None)
+        dt = int(dt_col[r])
+        datatype = (
+            "counter" if dt == 1 else "timestamp" if dt == 2 else None
+        )
+        return _Val(lv.decode_row_value(r), False, datatype)
+
+    def container(r: int) -> _Obj:
+        o = int(obj_col[r])
+        return objs[ROOT] if o < 0 else objs[opids[o]]
+
+    # map cells: all visible ops with a key, grouped by (container, key)
+    keys_items = lv.keys.items
+    for r in np.nonzero(visible & (key_col >= 0))[0].tolist():
+        obj = container(r)
+        obj.fields.setdefault(keys_items[int(key_col[r])], {})[
+            opids[r]
+        ] = val_of(r)
+
+    # element cells: own insert values + non-insert elem updates
+    for r in np.nonzero(visible & (insert_col == 1))[0].tolist():
+        obj = container(r)
+        obj.fields.setdefault(opids[r], {})[opids[r]] = val_of(r)
+    for r in np.nonzero(
+        visible & (insert_col == 0) & (key_col < 0) & (ref_col >= 0)
+    )[0].tolist():
+        obj = container(r)
+        elem = opids[int(ref_col[r])]
+        obj.fields.setdefault(elem, {})[opids[r]] = val_of(r)
+
+    # full element order (descending rank within each container),
+    # tombstones INCLUDED — OpSet keeps dead elems in `order` (remote
+    # RGA inserts reference them; the skip-scan walks them), and the
+    # incremental tick path mirrors OpSet op-for-op
+    ins_rows = np.nonzero(insert_col == 1)[0]
+    if len(ins_rows):
+        ins_rows = ins_rows[np.argsort(-rank[ins_rows], kind="stable")]
+        for r in ins_rows.tolist():
+            obj = container(r)
+            e = opids[r]
+            obj.order.append(e)
+            obj.fields.setdefault(e, {})
+    return state
+
+
+# ---------------------------------------------------------------------------
+# state diffing (delta patches + snapshots)
+
+
+def _diff_states(old: _DocState, new: _DocState) -> List[Diff]:
+    """Diffs transforming a frontend at `old` into `new`, walking the
+    reachable object graph exactly as OpSet._snapshot_obj does (so a
+    diff against the empty state is bit-identical to snapshot_patch).
+    Updates new.reachable as a side effect."""
+    diffs: List[Diff] = []
+    new.reachable = set()
+    visited: Set[OpId] = set()
+
+    def emit_obj(opid: OpId, fresh: bool) -> None:
+        if opid in visited:
+            return
+        visited.add(opid)
+        new.reachable.add(opid)
+        obj = new.objs[opid]
+        oid = ROOT_ID if opid == ROOT else str(opid)
+        old_obj = None
+        if not fresh:
+            old_obj = old.objs.get(opid)
+        if obj.is_sequence:
+            _emit_seq(opid, oid, obj, old_obj, fresh)
+        else:
+            _emit_map(oid, obj, old_obj, fresh)
+
+    def recurse_link(winner: OpId, link: bool) -> None:
+        if not link:
+            return
+        if winner in old.reachable and winner in old.objs:
+            emit_obj(winner, fresh=False)
+        else:
+            obj = new.objs[winner]
+            diffs.append(
+                Diff(action="create", obj=str(winner), obj_type=obj.type)
+            )
+            emit_obj(winner, fresh=True)
+
+    def _emit_map(oid, obj, old_obj, fresh) -> None:
+        old_fields = old_obj.fields if old_obj is not None else {}
+        for key in sorted(set(obj.fields) | set(old_fields)):
+            cell = obj.fields.get(key)
+            if not cell:
+                if old_fields.get(key):
+                    diffs.append(
+                        Diff(
+                            action="remove",
+                            obj=oid,
+                            obj_type=obj.type,
+                            key=key,
+                        )
+                    )
+                continue
+            winner, value, link, datatype, conflicts = _display(new, cell)
+            changed = True
+            old_cell = old_fields.get(key)
+            if not fresh and old_cell:
+                changed = _display(old, old_cell)[1:] != (
+                    value, link, datatype, conflicts
+                )
+            recurse_link(winner, link)
+            if changed:
+                diffs.append(
+                    Diff(
+                        action="set",
+                        obj=oid,
+                        obj_type=obj.type,
+                        key=key,
+                        value=value,
+                        link=link,
+                        datatype=datatype,
+                        conflicts=conflicts,
+                    )
+                )
+
+    def _emit_seq(opid, oid, obj, old_obj, fresh) -> None:
+        old_live = old_obj.live() if old_obj is not None else []
+        new_live = obj.live()
+        new_set = set(new_live)
+        old_set = set(old_live)
+        kept = 0
+        for e in old_live:
+            if e in new_set:
+                kept += 1
+            else:
+                diffs.append(
+                    Diff(
+                        action="remove",
+                        obj=oid,
+                        obj_type=obj.type,
+                        index=kept,
+                        elem_id=str(e),
+                    )
+                )
+        for j, e in enumerate(new_live):
+            cell = obj.fields[e]
+            winner, value, link, datatype, conflicts = _display(new, cell)
+            is_new = fresh or e not in old_set
+            changed = True
+            if not is_new:
+                old_cell = (
+                    old_obj.fields.get(e) if old_obj is not None else None
+                )
+                changed = not old_cell or _display(old, old_cell)[1:] != (
+                    value, link, datatype, conflicts
+                )
+            recurse_link(winner, link)
+            if is_new:
+                diffs.append(
+                    Diff(
+                        action="insert",
+                        obj=oid,
+                        obj_type=obj.type,
+                        index=j,
+                        elem_id=str(e),
+                        value=value,
+                        link=link,
+                        datatype=datatype,
+                        conflicts=conflicts,
+                    )
+                )
+            elif changed:
+                diffs.append(
+                    Diff(
+                        action="set",
+                        obj=oid,
+                        obj_type=obj.type,
+                        index=j,
+                        elem_id=str(e),
+                        value=value,
+                        link=link,
+                        datatype=datatype,
+                        conflicts=conflicts,
+                    )
+                )
+
+    emit_obj(ROOT, fresh=False)
+    # objects the frontend still holds that are now DETACHED: the host
+    # path streams their mutations too (FrontendDoc retains detached
+    # objects and applies diffs addressed to them), so a later
+    # re-attach links a CURRENT copy — dropping them here would leave
+    # the frontend's copy stale and diverge from the HM_LIVE=0 twin.
+    # Keeping them in new.reachable keeps successive ticks streaming.
+    for opid in sorted(old.reachable):
+        if opid in visited or opid not in new.objs or opid not in old.objs:
+            continue
+        emit_obj(opid, fresh=False)
+    return diffs
+
+
+# ---------------------------------------------------------------------------
+# per-doc live state
+
+
+class _LiveDoc:
+    __slots__ = (
+        "doc", "cols", "state", "clock", "max_op", "history_len",
+        "pending", "queued",
+    )
+
+    def __init__(self, doc, cols, state, clock, max_op, history_len):
+        self.doc = doc
+        self.cols: LiveColumns = cols
+        self.state: _DocState = state
+        self.clock: Dict[str, int] = clock
+        self.max_op: int = max_op
+        self.history_len: int = history_len
+        self.pending: Dict[Tuple[str, int], Change] = {}
+        self.queued: List[Change] = []
+
+
+class LiveApplyEngine:
+    """Dirty set + tick loop + shape-bucketed batch dispatch over the
+    live docs' cached columns. One engine per RepoBackend."""
+
+    def __init__(self, backend) -> None:
+        self._back = backend
+        self._lock = threading.RLock()
+        # the engine lock doubles as the GLOBAL emission lock while the
+        # engine is on: every {compute patch -> push} pair — engine
+        # ticks, apply_local echoes, send_ready_atomic, and the host
+        # path's DocBackend emissions — runs under this one re-entrant
+        # lock, so frontend callbacks dispatched synchronously from a
+        # push can re-enter the repo without a second lock to deadlock
+        # against.
+        self._docs: Dict[str, _LiveDoc] = {}
+        self._refused: Set[str] = set()  # adoption failed: host path
+        self._adopting: Set[str] = set()  # re-entrancy guard: opening
+        # a cursor actor during adoption can replay a window back into
+        # the same doc before its _LiveDoc is registered
+        self.stats: Dict[str, Any] = {
+            "adopted": 0, "refused": 0, "ticks": 0, "tick_docs": 0,
+            "tick_changes": 0, "inc_changes": 0, "kernel_runs": 0,
+            "device_dispatches": 0, "local_changes": 0,
+            "t_live_append": 0.0, "t_live_apply": 0.0,
+            "t_live_kernel": 0.0, "t_live_decode": 0.0,
+            "t_live_diff": 0.0,
+        }
+        self._ticker = Debouncer(
+            self._on_tick,
+            window_s=_tick_window_s(),
+            max_window_s=_tick_window_max_s(),
+            name="live-tick",
+            # work-conserving: under a sustained stream the next tick
+            # starts the moment the previous one ends (its duration IS
+            # the coalescing window); the 2ms window only pads the
+            # leading edge of a burst
+            eager=True,
+        )
+
+    @property
+    def emission_lock(self) -> threading.RLock:
+        """The lock host-path emissions must hold (see __init__)."""
+        return self._lock
+
+    # ------------------------------------------------------------------
+    # seams (called by DocBackend)
+
+    def submit_remote(self, doc, changes: List[Change]) -> bool:
+        """Admit + queue remote changes for the next tick. False when
+        the doc cannot be live-managed (caller takes the host path)."""
+        with self._lock:
+            ld = self._ensure_doc(doc)
+            if ld is None:
+                return False
+            if self._admit(ld, changes):
+                self._sync_doc_meta(ld)
+                self._ticker.mark(doc.id)
+        doc._check_ready()
+        return True
+
+    def apply_local(
+        self, doc, req: ChangeRequest, emit=None
+    ) -> Optional[Tuple[Change, Patch]]:
+        """Resolve + apply a local change against the live state
+        (OpSet.apply_local_request twin). None when the doc cannot be
+        live-managed; raises ValueError on an out-of-order seq.
+
+        `emit(change, patch)` runs while the engine lock is STILL held:
+        the patch's diffs are relative to the state just before this
+        change, so its push must reach the frontend queue before any
+        tick emits a delta on the post-change state — same ordering
+        contract as send_ready_atomic."""
+        with self._lock:
+            ld = self._ensure_doc(doc)
+            if ld is None:
+                return None
+            # pending admitted remotes apply (and notify) first, so the
+            # local resolution sees the same state the host path would
+            self._flush_ids([doc.id])
+            # the flush may have evicted the doc to the host path
+            # (_evict_to_host pops it and rebuilds the OpSet) — the old
+            # _LiveDoc is orphaned; the caller retries host-side
+            ld = self._docs.get(doc.id)
+            if ld is None:
+                return None
+            expected = ld.clock.get(req.actor, 0) + 1
+            if req.seq != expected:
+                raise ValueError(
+                    f"out-of-order local change: seq {req.seq} != "
+                    f"{expected}"
+                )
+            change, patch = self._apply_local_locked(ld, req)
+            self._sync_doc_meta(ld)
+            self.stats["local_changes"] += 1
+            if emit is not None:
+                emit(change, patch)
+        return change, patch
+
+    def snapshot_patch(self, doc) -> Optional[Patch]:
+        """From-scratch patch of the live state (OpSet.snapshot_patch
+        twin — served for Ready / reopen on adopted docs)."""
+        with self._lock:
+            ld = self._docs.get(doc.id)
+            if ld is None:
+                return None
+            self._flush_ids([doc.id])
+            ld = self._docs.get(doc.id)  # flush may evict to host path
+            if ld is None:
+                return None
+            # diff against an empty doc WITHOUT touching the tracked
+            # reachability (this is a read, not an emission to the
+            # incremental patch stream)
+            saved = ld.state.reachable
+            diffs = _diff_states(_DocState(), ld.state)
+            ld.state.reachable = saved
+            return Patch(
+                clock=dict(ld.clock),
+                deps=dict(ld.clock),
+                max_op=ld.max_op,
+                diffs=tuple(diffs),
+            )
+
+    def send_ready_atomic(self, doc, push, host_snapshot) -> None:
+        """Compute the doc's Ready snapshot and hand it to `push` while
+        STILL holding the engine lock. Ordering contract with the
+        frontend: a pending frontend drops every patch that precedes its
+        Ready in the queue (the snapshot carries their effects), so no
+        tick may interleave a delta for a NEWER state ahead of the Ready
+        push — holding the lock across the push guarantees it.
+
+        Docs the engine does not own snapshot host-side via
+        `host_snapshot()` — ALSO under the engine lock, which blocks a
+        concurrent adoption (it needs this lock) from ticking a delta
+        between the snapshot and the push. With the engine on, the
+        engine lock IS the host-path emission lock too (DocBackend
+        routes its {compute -> push} pairs through emission_lock), so
+        holding it here serializes against host-path emissions as
+        well. ONE re-entrant lock guards every emission: a frontend
+        callback that re-enters the repo on the emitting thread just
+        recurses, and no second lock exists to invert against (the
+        per-doc _emit_lock is only used by the HM_LIVE=0 twin, where
+        no engine lock exists)."""
+        with self._lock:  # re-entrant: snapshot_patch retakes it
+            patch = self.snapshot_patch(doc)
+            if patch is not None:
+                push(patch)
+                return
+            push(host_snapshot())
+
+    def drop(self, doc_id: str) -> None:
+        """Forget a doc's live state (close/destroy)."""
+        with self._lock:
+            self._docs.pop(doc_id, None)
+            self._refused.discard(doc_id)
+
+    def flush_now(self, timeout: float = 5.0) -> bool:
+        return self._ticker.flush_now(timeout)
+
+    def close(self) -> None:
+        self._ticker.close()
+
+    # ------------------------------------------------------------------
+    # adoption
+
+    def _ensure_doc(self, doc) -> Optional[_LiveDoc]:
+        ld = self._docs.get(doc.id)
+        if ld is not None:
+            return ld
+        if doc.id in self._refused:
+            return None
+        if doc.id in self._adopting:
+            return None  # recursive window during adoption: host path
+        self._adopting.add(doc.id)
+        try:
+            ld = self._adopt(doc)
+        finally:
+            self._adopting.discard(doc.id)
+        if ld is None:
+            self._refused.add(doc.id)
+            self.stats["refused"] += 1
+            # doc._live stays SET: _emission_lock must keep returning
+            # the engine lock for this doc's host-path emissions, or a
+            # refused doc's patches and its engine-locked Ready
+            # (send_ready_atomic) would be guarded by different locks
+            # and could interleave. The host path is still taken — the
+            # opset the fallback installs short-circuits the live
+            # branch, and _refused rejects re-adoption.
+        return ld
+
+    def _adopt(self, doc) -> Optional[_LiveDoc]:
+        """Build the doc's cached columns + decoded state from its feed
+        sidecars at its SERVING clock — no host OpSet replay. None when
+        a feed can't serve the window (non-contiguous seqs)."""
+        from ..ops.columnar import pack_docs_columns
+        from ..ops.host_kernel import run_batch_host
+
+        back = self._back
+        with doc._lock:
+            if doc.opset is not None or doc._lazy_loader is None:
+                return None
+            clock = dict(doc._lazy_clock or {})
+            history_len = doc._lazy_len
+        spec = []
+        for actor_id, end in clock.items():
+            if end <= 0:
+                continue
+            actor = back._get_or_create_actor(actor_id)
+            fc = actor.columns()
+            if not fc.seqs_contiguous() or fc.n_changes < end:
+                return None
+            spec.append((fc, 0, end))
+        batch = pack_docs_columns([spec] if spec else [[]])
+        lv = LiveColumns.from_batch(batch, 0)
+        if not self._ranges_ok(lv):
+            return None  # refuse BEFORE paying the kernel run
+        lanes = run_batch_host(batch)
+        state = _decode_state(lv, _LaneView(lanes, 0))
+        # the frontend's baseline is the Ready snapshot of this exact
+        # state: the snapshot walk computes what it can reach
+        _diff_states(_DocState(), state)  # sets state.reachable
+        with doc._lock:
+            if doc.opset is not None:
+                return None  # raced a host-side init: host wins
+            doc._live_adopted = True
+        ld = _LiveDoc(
+            doc, lv, state, clock,
+            int(batch.cols["ctr"][0].max(initial=0)), history_len,
+        )
+        self._docs[doc.id] = ld
+        self.stats["adopted"] += 1
+        return ld
+
+    @staticmethod
+    def _ranges_ok(lv: LiveColumns) -> bool:
+        A = max(1, len(lv.actors.items))
+        K = max(1, len(lv.keys.items))
+        n = lv.n
+        max_ctr = int(lv.cols["ctr"][:n].max(initial=0)) if n else 0
+        return (
+            max_ctr * A + A < 2**30 and (n + 1) * (K + 1) + K < 2**31
+        )
+
+    # ------------------------------------------------------------------
+    # causal admission (OpSet _enqueue/_drain_pending twin)
+
+    def _admit(self, ld: _LiveDoc, changes: List[Change]) -> bool:
+        for c in changes:
+            if c.seq <= ld.clock.get(c.actor, 0):
+                continue  # duplicate / already applied
+            ld.pending.setdefault((c.actor, c.seq), c)
+        progressed = True
+        admitted = False
+        while progressed and ld.pending:
+            progressed = False
+            for key in list(ld.pending):
+                c = ld.pending[key]
+                if c.seq != ld.clock.get(c.actor, 0) + 1:
+                    continue
+                if any(
+                    ld.clock.get(a, 0) < s for a, s in c.deps.items()
+                ):
+                    continue
+                del ld.pending[key]
+                ld.clock[c.actor] = c.seq
+                ld.max_op = max(ld.max_op, c.max_op)
+                ld.history_len += 1
+                ld.queued.append(c)
+                progressed = True
+                admitted = True
+        return admitted
+
+    def _sync_doc_meta(self, ld: _LiveDoc) -> None:
+        doc = ld.doc
+        with doc._lock:
+            doc._lazy_clock = dict(ld.clock)
+            doc._lazy_len = ld.history_len
+
+    # ------------------------------------------------------------------
+    # the tick
+
+    def _on_tick(self, marked: Dict) -> None:
+        with self._lock:
+            self._flush_ids(list(marked))
+
+    def _flush_ids(self, doc_ids: List[str]) -> None:
+        """Apply every queued change of the named docs; emit one delta
+        patch per doc. Small ticks apply INCREMENTALLY — O(tick ops)
+        direct state application through the OpSet-twin _apply_op_state
+        (the ROADMAP'd row-delta constant: a trickle of edits must not
+        pay an O(doc) kernel+decode+diff per tick). Big catch-up ticks
+        (ops x rows over the budget) take the shape-bucketed kernel
+        dispatch, where the vectorized rebuild amortizes. Caller holds
+        the engine lock."""
+        now = time.perf_counter
+        dirty = [
+            self._docs[d]
+            for d in doc_ids
+            if d in self._docs and self._docs[d].queued
+        ]
+        if not dirty:
+            return
+        stats = self.stats
+        t0 = now()
+        batches = []
+        for ld in dirty:
+            changes = ld.queued
+            ld.queued = []
+            stats["tick_changes"] += len(changes)
+            ld.cols.append_changes(changes)
+            if not self._ranges_ok(ld.cols):
+                self._evict_to_host(ld)
+                continue
+            batches.append((ld, changes))
+        stats["t_live_append"] = round(
+            stats["t_live_append"] + now() - t0, 6
+        )
+        stats["ticks"] += 1
+        stats["tick_docs"] += len(batches)
+
+        budget = _inc_budget_cells()
+        kernel_docs: List[_LiveDoc] = []
+        for ld, changes in batches:
+            n_ops = sum(len(c.ops) for c in changes)
+            if n_ops > 8 and n_ops * max(ld.cols.n, 1) > budget:
+                kernel_docs.append(ld)
+                continue
+            t1 = now()
+            diffs: List[Diff] = []
+            for c in changes:
+                for i, op in enumerate(c.ops):
+                    self._apply_op_state(ld.state, c.op_id(i), op, diffs)
+            stats["inc_changes"] += len(changes)
+            stats["t_live_apply"] = round(
+                stats["t_live_apply"] + now() - t1, 6
+            )
+            self._emit_tick(ld, diffs)
+        if not kernel_docs:
+            return
+
+        # shape buckets: docs whose row counts share a pow2 bucket ride
+        # one padded dispatch (and successive ticks reuse its program)
+        from ..ops.crdt_kernels import LIVE_MIN_ROWS, live_bucket
+
+        groups: Dict[int, List[_LiveDoc]] = {}
+        for ld in kernel_docs:
+            groups.setdefault(
+                live_bucket(ld.cols.n, LIVE_MIN_ROWS), []
+            ).append(ld)
+        for bucket_n, lds in sorted(groups.items()):
+            self._run_group(bucket_n, lds)
+
+    def _emit_tick(self, ld: _LiveDoc, diffs: List[Diff]) -> None:
+        self._sync_doc_meta(ld)
+        doc = ld.doc
+        if diffs and doc._announced:
+            patch = Patch(
+                clock=dict(ld.clock),
+                deps=dict(ld.clock),
+                max_op=ld.max_op,
+                diffs=tuple(diffs),
+            )
+            doc._notify(
+                {"type": "RemotePatch", "doc": doc, "patch": patch}
+            )
+        doc._check_ready()
+
+    def _run_group(self, bucket_n: int, lds: List[_LiveDoc]) -> None:
+        now = time.perf_counter
+        stats = self.stats
+        t0 = now()
+        lanes_by_doc = self._kernel(bucket_n, lds)
+        stats["t_live_kernel"] = round(
+            stats["t_live_kernel"] + now() - t0, 6
+        )
+        for ld, lanes in zip(lds, lanes_by_doc):
+            t1 = now()
+            new_state = _decode_state(ld.cols, lanes)
+            t2 = now()
+            diffs = _diff_states(ld.state, new_state)
+            ld.state = new_state
+            stats["t_live_decode"] = round(
+                stats["t_live_decode"] + t2 - t1, 6
+            )
+            stats["t_live_diff"] = round(
+                stats["t_live_diff"] + now() - t2, 6
+            )
+            self._emit_tick(ld, diffs)
+
+    def _kernel(self, bucket_n: int, lds: List[_LiveDoc]):
+        """Run the materialize kernel over the group; returns one lane
+        view per doc. Device when the padded batch clears the min-cells
+        bar, numpy twin otherwise (both bit-identical — the twin is the
+        fuzz reference)."""
+        from ..ops.host_kernel import _host_doc_kernel
+
+        D = len(lds)
+        if D * bucket_n < _device_min_cells():
+            self.stats["kernel_runs"] += 1
+            outs = []
+            for ld in lds:
+                lv = ld.cols
+                n = lv.n
+                A = max(1, len(lv.actors.items))
+                K = max(1, len(lv.keys.items))
+                c = lv.cols
+                outs.append(
+                    _host_doc_kernel(
+                        c["action"][:n], lv.slots(), c["ctr"][:n],
+                        np.zeros(n, np.int32), c["obj"][:n],
+                        c["key"][:n], c["ref"][:n], c["insert"][:n],
+                        c["value"][:n], lv.psrc[: lv.n_preds],
+                        lv.ptgt[: lv.n_preds],
+                        np.arange(A, dtype=np.int32), A, K,
+                    )
+                )
+            return outs
+        return self._kernel_device(bucket_n, lds)
+
+    def _kernel_device(self, bucket_n: int, lds: List[_LiveDoc]):
+        from ..ops.crdt_kernels import (
+            LIVE_MIN_DOCS,
+            live_bucket,
+            materialize_live_device,
+        )
+
+        self.stats["kernel_runs"] += 1
+        self.stats["device_dispatches"] += 1
+        D = live_bucket(len(lds), LIVE_MIN_DOCS)
+        N = bucket_n
+        A = live_bucket(
+            max(len(ld.cols.actors.items) for ld in lds), 4
+        )
+        K = live_bucket(max(len(ld.cols.keys.items) for ld in lds), 16)
+        P = live_bucket(max(ld.cols.n_preds for ld in lds), 16)
+        from ..ops.columnar import PAD
+
+        flags = np.zeros((D, N), np.uint8)
+        flags[:, :] = PAD
+        slot = np.zeros((D, N), np.int32)
+        ctr = np.zeros((D, N), np.int32)
+        obj = np.full((D, N), -1, np.int32)
+        key = np.full((D, N), -1, np.int32)
+        ref = np.full((D, N), -3, np.int32)
+        value = np.zeros((D, N), np.int32)
+        psrc = np.full((D, P), -1, np.int32)
+        ptgt = np.full((D, P), -1, np.int32)
+        for d, ld in enumerate(lds):
+            lv = ld.cols
+            n, npred = lv.n, lv.n_preds
+            c = lv.cols
+            flags[d, :n] = (
+                c["action"][:n].astype(np.uint8)
+                | (c["insert"][:n].astype(np.uint8) << 3)
+            )
+            slot[d, :n] = lv.slots()
+            ctr[d, :n] = c["ctr"][:n]
+            obj[d, :n] = c["obj"][:n]
+            key[d, :n] = c["key"][:n]
+            ref[d, :n] = c["ref"][:n]
+            value[d, :n] = c["value"][:n]
+            psrc[d, :npred] = lv.psrc[:npred]
+            ptgt[d, :npred] = lv.ptgt[:npred]
+        out = materialize_live_device(
+            flags, slot, ctr, obj, key, ref, value, psrc, ptgt, A=A, K=K
+        )
+        host = {
+            name: np.asarray(getattr(out, name))
+            for name in ("visible", "elem_live", "rank", "inc_total")
+        }
+        return [_LaneDict(host, d) for d in range(len(lds))]
+
+    def _evict_to_host(self, ld: _LiveDoc) -> None:
+        """A doc outgrew the kernel's composite ranges: hand it back to
+        the host OpSet path. Everything admitted is already in the
+        feeds, so the explicit replay (at the serving clock) rebuilds
+        the exact state; un-admitted pending changes re-queue so none
+        is lost."""
+        doc = ld.doc
+        log("live", f"evicting {doc.id[:6]} to host path (range)")
+        self._docs.pop(doc.id, None)
+        self._refused.add(doc.id)
+        with doc._lock:
+            # doc._live stays set (see _ensure_doc): emissions keep the
+            # engine lock so the Ready ordering contract holds
+            doc._live_adopted = False
+            doc._lazy_clock = dict(ld.clock)
+            doc._lazy_len = ld.history_len
+        doc._ensure_opset()  # the documented fallback: full host replay
+        if ld.pending:
+            doc.apply_remote_changes(list(ld.pending.values()))
+
+    # ------------------------------------------------------------------
+    # local change resolution (OpSet.apply_local_request twin)
+
+    def _apply_local_locked(
+        self, ld: _LiveDoc, req: ChangeRequest
+    ) -> Tuple[Change, Patch]:
+        state = ld.state
+        start_op = ld.max_op + 1
+        deps = {a: s for a, s in ld.clock.items() if a != req.actor}
+        temp_map: Dict[str, OpId] = {}
+        ops: List[Op] = []
+        diffs: List[Diff] = []
+        ctr = start_op
+        for intent in req.intents:
+            op = self._resolve_intent(
+                state, intent, OpId(ctr, req.actor), temp_map
+            )
+            if op is None:
+                continue
+            self._apply_op_state(state, OpId(ctr, req.actor), op, diffs)
+            ops.append(op)
+            ctr += 1
+        change = Change(
+            actor=req.actor,
+            seq=req.seq,
+            start_op=start_op,
+            deps=deps,
+            ops=tuple(ops),
+            time=req.time,
+            message=req.message,
+        )
+        ld.cols.append_changes([change])
+        ld.clock[req.actor] = req.seq
+        ld.max_op = max(ld.max_op, change.max_op)
+        ld.history_len += 1
+        patch = Patch(
+            clock=dict(ld.clock),
+            deps=dict(ld.clock),
+            max_op=ld.max_op,
+            diffs=tuple(diffs),
+            actor=req.actor,
+            seq=req.seq,
+        )
+        return change, patch
+
+    @staticmethod
+    def _resolve_intent(
+        state: _DocState, intent, opid: OpId, temp_map
+    ) -> Optional[Op]:
+        # the SHARED resolver (crdt/opset.py) — one implementation for
+        # both HM_LIVE twins, parameterized over this engine's decoded
+        # state (_Obj has the same .is_sequence/.fields shape)
+        from ..crdt.opset import resolve_intent
+
+        return resolve_intent(
+            intent, opid, temp_map, state.objs.get, _Obj.live
+        )
+
+    def _apply_op_state(
+        self, state: _DocState, opid: OpId, op: Op, diffs: List[Diff]
+    ) -> None:
+        """OpSet._apply_op twin over the decoded state — ONE
+        implementation serves both local resolution and the incremental
+        remote tick path, so the two engines cannot drift."""
+        obj = state.objs.get(op.obj)
+        if obj is None:
+            return  # tolerate ops against unknown objects (OpSet does)
+        if op.action.makes_object and opid not in state.objs:
+            child_type = OBJ_TYPE_BY_MAKE[op.action]
+            state.objs[opid] = _Obj(child_type)
+            state.reachable.add(opid)
+            diffs.append(
+                Diff(action="create", obj=str(opid), obj_type=child_type)
+            )
+        val = _Val(
+            None if op.action.makes_object else op.value,
+            op.action.makes_object,
+            None if op.action.makes_object else op.datatype,
+        )
+        if obj.is_sequence:
+            self._apply_seq_state(state, obj, opid, op, val, diffs)
+        else:
+            self._apply_map_state(state, obj, opid, op, val, diffs)
+
+    @staticmethod
+    def _obj_str(op: Op) -> str:
+        return ROOT_ID if op.obj == ROOT else str(op.obj)
+
+    @staticmethod
+    def _live_index(obj: _Obj, elem: OpId) -> int:
+        """Index among LIVE elems (OpSet._live_index twin)."""
+        idx = 0
+        for e in obj.order:
+            if e == elem:
+                return idx
+            if obj.fields.get(e):
+                idx += 1
+        return idx
+
+    def _apply_map_state(self, state, obj, opid, op, val, diffs) -> None:
+        key = op.key
+        if key is None:
+            return
+        visible = obj.fields.setdefault(key, {})
+        had = bool(visible)
+        if op.action == Action.INC:
+            for p in op.pred:
+                if p in visible:
+                    state.inc[p] = state.inc.get(p, 0) + (op.value or 0)
+        else:
+            for p in op.pred:
+                if visible.pop(p, None) is not None:
+                    state.inc.pop(p, None)
+            if op.action == Action.SET or op.action.makes_object:
+                visible[opid] = val
+        oid = self._obj_str(op)
+        if not visible:
+            if had:
+                diffs.append(
+                    Diff(
+                        action="remove",
+                        obj=oid,
+                        obj_type=obj.type,
+                        key=key,
+                    )
+                )
+            else:
+                obj.fields.pop(key, None)
+            return
+        winner, value, link, datatype, conflicts = _display(state, visible)
+        diffs.append(
+            Diff(
+                action="set",
+                obj=oid,
+                obj_type=obj.type,
+                key=key,
+                value=value,
+                link=link,
+                datatype=datatype,
+                conflicts=conflicts,
+            )
+        )
+
+    def _apply_seq_state(self, state, obj, opid, op, val, diffs) -> None:
+        oid = self._obj_str(op)
+        if op.insert:
+            # RGA insert-after with descending-OpId skip scan (OpSet's
+            # algorithm verbatim; `order` includes tombstones)
+            if op.ref == HEAD:
+                pos = 0
+            else:
+                try:
+                    pos = obj.order.index(op.ref) + 1
+                except ValueError:
+                    return  # unknown predecessor
+            while pos < len(obj.order) and obj.order[pos] > opid:
+                pos += 1
+            obj.order.insert(pos, opid)
+            obj.fields[opid] = {opid: val}
+            value, link, datatype = _op_value(state, opid, val)
+            diffs.append(
+                Diff(
+                    action="insert",
+                    obj=oid,
+                    obj_type=obj.type,
+                    index=self._live_index(obj, opid),
+                    elem_id=str(opid),
+                    value=value,
+                    link=link,
+                    datatype=datatype,
+                )
+            )
+            return
+        elem = op.ref
+        if elem is None or elem not in obj.fields:
+            return
+        visible = obj.fields[elem]
+        had = bool(visible)
+        if op.action == Action.INC:
+            for p in op.pred:
+                if p in visible:
+                    state.inc[p] = state.inc.get(p, 0) + (op.value or 0)
+        else:
+            for p in op.pred:
+                if visible.pop(p, None) is not None:
+                    state.inc.pop(p, None)
+            if op.action == Action.SET or op.action.makes_object:
+                visible[opid] = val
+        if visible:
+            winner, value, link, datatype, conflicts = _display(
+                state, visible
+            )
+            diffs.append(
+                Diff(
+                    # a tombstoned elem coming back to life (concurrent
+                    # set vs delete) is an *insert* to the frontend
+                    action="set" if had else "insert",
+                    obj=oid,
+                    obj_type=obj.type,
+                    index=self._live_index(obj, elem),
+                    elem_id=str(elem),
+                    value=value,
+                    link=link,
+                    datatype=datatype,
+                    conflicts=conflicts,
+                )
+            )
+        elif had:
+            # tombstone RETAINED in order/fields (OpSet keeps it: later
+            # remote inserts may reference this elem)
+            diffs.append(
+                Diff(
+                    action="remove",
+                    obj=oid,
+                    obj_type=obj.type,
+                    index=self._live_index(obj, elem),
+                    elem_id=str(elem),
+                )
+            )
+
+
+# ---------------------------------------------------------------------------
+# lane adapters
+
+
+class _LaneView:
+    """Per-doc view over stacked HostOut lanes."""
+
+    __slots__ = ("visible", "elem_live", "rank", "inc_total")
+
+    def __init__(self, out, d: int) -> None:
+        self.visible = np.asarray(out.visible[d])
+        self.elem_live = np.asarray(out.elem_live[d])
+        self.rank = np.asarray(out.rank[d])
+        self.inc_total = np.asarray(out.inc_total[d])
+
+
+class _LaneDict:
+    __slots__ = ("visible", "elem_live", "rank", "inc_total")
+
+    def __init__(self, host: Dict[str, np.ndarray], d: int) -> None:
+        self.visible = host["visible"][d]
+        self.elem_live = host["elem_live"][d]
+        self.rank = host["rank"][d]
+        self.inc_total = host["inc_total"][d]

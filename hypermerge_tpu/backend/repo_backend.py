@@ -159,6 +159,32 @@ class RepoBackend:
             / 1e3,
             name="colcache",
         )
+        # clock/cursor rows are monotonic latest-state: a burst of live
+        # patches coalesces into one executemany per window instead of
+        # a per-change upsert + read-back (the in-memory doc clock is
+        # authoritative; rows rebuild from feeds after a crash)
+        self._stores = Debouncer(
+            self._flush_store_rows,
+            window_s=float(os.environ.get("HM_STORE_FLUSH_MS", "5"))
+            / 1e3,
+            name="stores",
+        )
+        # read once: _mark_clock_row/_mark_cursor_row run per patch,
+        # _flush_gossip per debounce window
+        self._store_debounce = (
+            os.environ.get("HM_STORE_DEBOUNCE", "1") != "0"
+        )
+        self._gossip_fresh = (
+            os.environ.get("HM_GOSSIP_FRESH", "1") != "0"
+        )
+        # live apply engine (backend/live.py): incremental changes on
+        # lazy docs batch through per-tick kernel dispatches. HM_LIVE=0
+        # keeps the host-OpSet path as the correctness twin.
+        self.live = None
+        if os.environ.get("HM_LIVE", "1") != "0":
+            from .live import LiveApplyEngine
+
+            self.live = LiveApplyEngine(self)
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -210,7 +236,7 @@ class RepoBackend:
 
     def create(self, public_key: str, secret_key: str) -> DocBackend:
         doc_id = public_key
-        doc = DocBackend(doc_id, self._doc_notify, None)
+        doc = DocBackend(doc_id, self._doc_notify, None, live=self.live)
         with self._lock:
             self.docs[doc_id] = doc
         self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
@@ -221,16 +247,41 @@ class RepoBackend:
     def open(self, doc_id: str) -> DocBackend:
         with self._lock:
             doc = self.docs.get(doc_id)
-            if doc is not None:
-                if doc._announced:
-                    # a (re)opened frontend needs the Ready snapshot again
-                    self._send_ready(doc)
-                return doc
-            doc = DocBackend(doc_id, self._doc_notify, None)
-            self.docs[doc_id] = doc
-        self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
-        if not self._load_document_fast(doc):
-            self._load_document(doc)
+            if doc is None:
+                doc = DocBackend(
+                    doc_id, self._doc_notify, None, live=self.live
+                )
+                self.docs[doc_id] = doc
+                existing = None
+            else:
+                existing = doc
+        if existing is not None:
+            if existing._announced:
+                # a (re)opened frontend needs the Ready snapshot again.
+                # OUTSIDE self._lock: the snapshot takes the live-engine
+                # lock, and engine->repo is the established lock order
+                # (adoption opens actors under self._lock) — holding
+                # repo->engine here would deadlock against a tick.
+                self._send_ready(existing)
+            return existing
+        try:
+            # a doc closed with store rows still in the debouncer must
+            # not reload from the stale rows (load reads cursor/clock
+            # directly)
+            self._settle_store_rows(doc_id)
+            self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
+            if not self._load_document_fast(doc):
+                self._load_document(doc)
+        except BaseException:
+            # a failed load must not leave the blank doc registered:
+            # every later open() would return it as-is (never loaded,
+            # never Ready) even after the failure clears
+            with self._lock:
+                if self.docs.get(doc_id) is doc:
+                    del self.docs[doc_id]
+            if self.live is not None:
+                self.live.drop(doc_id)
+            raise
         return doc
 
     def merge(self, doc_id: str, clock: clockmod.Clock) -> None:
@@ -247,6 +298,8 @@ class RepoBackend:
     def close_doc(self, doc_id: str) -> None:
         with self._lock:
             self.docs.pop(doc_id, None)
+        if self.live is not None:
+            self.live.drop(doc_id)
 
     def destroy(self, doc_id: str) -> None:
         """Remove ALL doc state: store rows AND the on-disk feeds
@@ -255,6 +308,9 @@ class RepoBackend:
         their feeds. (The reference stubs destroy out —
         src/RepoBackend.ts:632-635; here it reclaims disk for real.)"""
         self.close_doc(doc_id)
+        # pending debounced rows flushed after the delete would
+        # resurrect the destroyed doc's rows — land them first
+        self._settle_store_rows(doc_id)
         actors = list(self.cursors.get(self.id, doc_id))
         self.clocks.delete_doc(doc_id)  # peers' rows included
         self.cursors.delete_doc(self.id, doc_id)
@@ -470,9 +526,14 @@ class RepoBackend:
                     if existing._announced:
                         already_ready.append(doc_id)
                     continue
-                doc = DocBackend(doc_id, self._doc_notify, None)
+                doc = DocBackend(
+                    doc_id, self._doc_notify, None, live=self.live
+                )
                 self.docs[doc_id] = doc
                 new_docs.append(doc)
+        # docs closed with store rows still in the debouncer must not
+        # bulk-reload from the stale rows (same guard as open/destroy)
+        self._settle_store_rows({d.id for d in new_docs})
         with self.db.bulk():
             self.cursors.add_actors(
                 self.id, [(d.id, root_actor_id(d.id)) for d in new_docs]
@@ -1261,6 +1322,87 @@ class RepoBackend:
     # ------------------------------------------------------------------
     # notifications from docs / actors
 
+    def _settle_store_rows(self, doc_ids) -> None:
+        """Block until the named docs' debounced store rows are durable
+        (single id or a collection — bulk reopens settle in one pass).
+        Cheap no-op unless a doc actually has rows in flight, so
+        open/destroy don't stall behind unrelated traffic. A wedged
+        flusher raises instead of returning: proceeding would reload
+        from stale rows (open) or let a late flush resurrect rows the
+        caller is about to delete (destroy)."""
+        if isinstance(doc_ids, str):
+            doc_ids = {doc_ids}
+        deadline = time.monotonic() + 30.0
+        while any(k[1] in doc_ids for k in self._stores.pending()):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise RuntimeError(
+                    "store flusher failed to drain rows for docs "
+                    f"{sorted(doc_ids)[:3]} within 30s"
+                )
+            # a False return only means the GLOBAL queue didn't drain;
+            # this doc's rows may have landed — the loop re-checks
+            self._stores.flush_now(timeout=min(remaining, 1.0))
+
+    def _overlay_pending_rows(self, doc_id: str, cursor, clock, pend=None):
+        """Overlay rows still inside the store debouncer onto values
+        read back from the store, so advertisement paths (gossip,
+        discovery) are read-your-writes: a gossip flush racing ahead of
+        the store flush must NOT advertise a stale cursor — a peer that
+        believes the stale seq never requests the newer blocks, and if
+        no later change re-gossips, replication stalls permanently.
+        Multi-doc callers pass one `pend` snapshot for the whole loop
+        (pending() copies the dict under the debouncer cv each call)."""
+        if pend is None:
+            pend = self._stores.pending()
+        if not pend:
+            return cursor, clock
+        cursor = dict(cursor)
+        clock = dict(clock)
+        for key, val in pend.items():
+            if key[0] == "c" and key[1] == doc_id:
+                for actor, seq in val.items():
+                    if seq > clock.get(actor, 0):
+                        clock[actor] = seq
+            elif key[0] == "u" and key[1] == doc_id:
+                actor = key[2]
+                if val > cursor.get(actor, 0):
+                    cursor[actor] = val
+        return cursor, clock
+
+    def _mark_clock_row(self, doc: DocBackend) -> None:
+        """Queue the doc's (in-memory, authoritative) clock for the
+        debounced store flush — a burst of patches costs one upsert."""
+        if not self._store_debounce:
+            self.clocks.update(self.id, doc.id, doc.clock)
+            return
+        self._stores.mark(("c", doc.id), doc.clock)
+
+    def _mark_cursor_row(
+        self, doc: DocBackend, actor_id: str, seq: int
+    ) -> None:
+        """Cursor twin of _mark_clock_row: HM_STORE_DEBOUNCE=0 must
+        restore the synchronous write here too, or the 'debounce off'
+        twin still flushes cursor rows asynchronously."""
+        if not self._store_debounce:
+            self.cursors.update(self.id, doc.id, {actor_id: seq})
+            return
+        self._stores.mark(("u", doc.id, actor_id), seq)
+
+    def _flush_store_rows(self, batch: Dict) -> None:
+        clocks: Dict[str, Dict[str, int]] = {}
+        cursor_rows = []
+        for key, val in batch.items():
+            if key[0] == "c":
+                clocks[key[1]] = val
+            else:
+                cursor_rows.append((key[1], key[2], val))
+        with self.db.bulk():
+            if clocks:
+                self.clocks.update_many(self.id, clocks)
+            if cursor_rows:
+                self.cursors.update_many_rows(self.id, cursor_rows)
+
     def _doc_notify(self, event: Dict[str, Any]) -> None:
         t = event["type"]
         doc: DocBackend = event["doc"]
@@ -1273,9 +1415,8 @@ class RepoBackend:
                 actor.write_change(change)
             else:
                 log("repo:backend", "no writable actor for", change.actor[:6])
-            clock = doc.clock
-            self.clocks.update(self.id, doc.id, clock)
-            self.cursors.update(self.id, doc.id, {change.actor: change.seq})
+            self._mark_clock_row(doc)
+            self._mark_cursor_row(doc, change.actor, change.seq)
             self.to_frontend.push(
                 msgs.patch_msg(
                     doc.id, event["patch"].to_json(), doc.history_len
@@ -1283,7 +1424,7 @@ class RepoBackend:
             )
             self._gossip_cursor(doc)
         elif t == "RemotePatch":
-            self.clocks.update(self.id, doc.id, doc.clock)
+            self._mark_clock_row(doc)
             self.to_frontend.push(
                 msgs.patch_msg(
                     doc.id, event["patch"].to_json(), doc.history_len
@@ -1300,16 +1441,35 @@ class RepoBackend:
             )
 
     def _send_ready(self, doc: DocBackend) -> None:
-        patch = doc.snapshot_patch()
-        self.clocks.update(self.id, doc.id, doc.clock)
-        self.to_frontend.push(
-            msgs.ready_msg(
-                doc.id,
-                doc.actor_id,
-                patch.to_json() if patch else None,
-                doc.history_len,
+        def push(patch) -> None:
+            self._mark_clock_row(doc)
+            self.to_frontend.push(
+                msgs.ready_msg(
+                    doc.id,
+                    doc.actor_id,
+                    patch.to_json() if patch else None,
+                    doc.history_len,
+                )
             )
-        )
+
+        # with the live engine on, BOTH paths run under the engine lock
+        # (live.send_ready_atomic): engine-owned docs so no tick can
+        # slip a newer delta ahead of the Ready in the queue, and
+        # host-side docs so a racing adoption can't start ticking
+        # between the snapshot and the push (a pending frontend drops
+        # pre-Ready patches — live.py contract). The engine lock is the
+        # ONLY emission lock while the engine is on (DocBackend's host
+        # paths route through it too, via _emission_lock) — there is no
+        # second lock for a synchronously-dispatched frontend callback
+        # to invert against.
+        if self.live is not None:
+            self.live.send_ready_atomic(doc, push, doc.snapshot_patch)
+            return
+        # host twin (HM_LIVE=0): atomicity via the doc's emission lock —
+        # a concurrent _handle_remote/_handle_local cannot push a patch
+        # for a state newer than this snapshot before the Ready lands
+        with doc._emit_lock:
+            push(doc.snapshot_patch())
 
     def _actor_notify(self, event: Dict[str, Any]) -> None:
         t = event["type"]
@@ -1417,13 +1577,15 @@ class RepoBackend:
         """A feed shared with `peer` was discovered: send our cursor +
         clock for every doc that includes that actor (reference
         src/RepoBackend.ts:374-392)."""
+        pend = self._stores.pending()  # one snapshot for the loop
         for doc_id in self.cursors.docs_with_actor(self.id, public_id):
-            self.network.send_cursor_to(
-                peer,
+            cursor, clock = self._overlay_pending_rows(
                 doc_id,
                 self.cursors.get(self.id, doc_id),
                 self.clocks.get(self.id, doc_id),
+                pend=pend,
             )
+            self.network.send_cursor_to(peer, doc_id, cursor, clock)
 
     def _gossip_cursor(self, doc: DocBackend) -> None:
         self._gossip.mark(doc.id)
@@ -1431,12 +1593,21 @@ class RepoBackend:
     def _flush_gossip(self, doc_ids) -> None:
         if self.network is None or self._closed:
             return
+        fresh = self._gossip_fresh
+        pend = self._stores.pending()  # one snapshot for the loop
         for doc_id in doc_ids:
-            self.network.gossip_cursor(
-                doc_id,
-                self.cursors.get(self.id, doc_id),
-                self.clocks.get(self.id, doc_id),
+            # an open doc's in-memory clock is fresher than its store
+            # row (clock rows flush debounced — _flush_store_rows)
+            doc = self.docs.get(doc_id) if fresh else None
+            clock = (
+                doc.clock if doc is not None
+                else self.clocks.get(self.id, doc_id)
             )
+            cursor, clock = self._overlay_pending_rows(
+                doc_id, self.cursors.get(self.id, doc_id), clock,
+                pend=pend,
+            )
+            self.network.gossip_cursor(doc_id, cursor, clock)
 
     def _announce_file_feed(self, feed) -> None:
         """File feeds replicate like any feed (reference
@@ -1514,9 +1685,12 @@ class RepoBackend:
                 ctx.join()
             except Exception as e:
                 log("repo:backend", f"bulk fetch at close: {e}")
+        if self.live is not None:
+            self.live.close()  # drains: final tick patches still emit
         self._gossip.close()
         self._syncs.close()
         self._cache_syncs.close()  # drains: sidecars durable on close
+        self._stores.close()  # drains AFTER patch sources: last rows land
         if self._file_server is not None:
             self._file_server.close()
             self._file_server = None

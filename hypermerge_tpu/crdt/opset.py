@@ -43,6 +43,96 @@ from .patch import Conflict, Diff, Patch
 ROOT_STR = str(ROOT)
 
 
+def resolve_intent(
+    intent, opid: OpId, temp_map: Dict[str, OpId], objects_get, live_elems
+) -> Optional[Op]:
+    """Translate one frontend intent into a concrete Op against the
+    current visible state. ONE implementation shared by the host OpSet
+    and the live apply engine (backend/live.py) so the HM_LIVE=1/0
+    twins cannot drift on local-change resolution — parameterized over
+    the state representation: `objects_get(obj_id)` returns an object
+    with `.is_sequence` + `.fields` (or None), `live_elems(obj)` its
+    live element order."""
+    if intent.obj in temp_map:
+        obj_id = temp_map[intent.obj]
+    elif intent.obj == ROOT_STR or intent.obj == "_root":
+        obj_id = ROOT
+    elif intent.obj.startswith("tmp:"):
+        return None  # references a temp id whose MAKE failed
+    else:
+        try:
+            obj_id = OpId.parse(intent.obj)
+        except ValueError:
+            return None
+    obj = objects_get(obj_id)
+    if obj is None:
+        return None
+    op = build_intent_op(intent, obj_id, obj, live_elems)
+    if op is not None and intent.temp_id is not None:
+        # register only on success: a failed intent must not alias its
+        # temp id onto the OpId the next successful op will consume
+        temp_map[intent.temp_id] = opid
+    return op
+
+
+def build_intent_op(intent, obj_id: OpId, obj, live_elems) -> Optional[Op]:
+    action = intent.action
+    if obj.is_sequence:
+        if intent.insert:
+            live = live_elems(obj)
+            idx = intent.index if intent.index is not None else len(live)
+            if idx < 0 or idx > len(live):
+                return None
+            ref = HEAD if idx == 0 else live[idx - 1]
+            return Op(
+                action=action,
+                obj=obj_id,
+                ref=ref,
+                insert=True,
+                value=intent.value,
+                datatype=intent.datatype,
+            )
+        live = live_elems(obj)
+        if intent.index is None or not (0 <= intent.index < len(live)):
+            return None
+        elem = live[intent.index]
+        visible = obj.fields.get(elem, {})
+        if action == Action.INC:
+            target = max(visible) if visible else None
+            if target is None:
+                return None
+            return Op(
+                action=action, obj=obj_id, ref=elem, value=intent.value,
+                pred=(target,),
+            )
+        return Op(
+            action=action,
+            obj=obj_id,
+            ref=elem,
+            value=intent.value,
+            datatype=intent.datatype,
+            pred=tuple(sorted(visible)),
+        )
+    # map/table
+    visible = obj.fields.get(intent.key, {})
+    if action == Action.INC:
+        target = max(visible) if visible else None
+        if target is None:
+            return None
+        return Op(
+            action=action, obj=obj_id, key=intent.key,
+            value=intent.value, pred=(target,),
+        )
+    return Op(
+        action=action,
+        obj=obj_id,
+        key=intent.key,
+        value=intent.value,
+        datatype=intent.datatype,
+        pred=tuple(sorted(visible)),
+    )
+
+
 @dataclass
 class _Obj:
     """State of one object (map/table/list/text)."""
@@ -158,82 +248,8 @@ class OpSet:
     def _resolve_intent(
         self, intent, opid: OpId, temp_map: Dict[str, OpId]
     ) -> Optional[Op]:
-        if intent.obj in temp_map:
-            obj_id = temp_map[intent.obj]
-        elif intent.obj == ROOT_STR or intent.obj == "_root":
-            obj_id = ROOT
-        elif intent.obj.startswith("tmp:"):
-            return None  # references a temp id whose MAKE failed
-        else:
-            try:
-                obj_id = OpId.parse(intent.obj)
-            except ValueError:
-                return None
-        obj = self.objects.get(obj_id)
-        if obj is None:
-            return None
-        op = self._build_intent_op(intent, obj_id, obj)
-        if op is not None and intent.temp_id is not None:
-            # register only on success: a failed intent must not alias its
-            # temp id onto the OpId the next successful op will consume
-            temp_map[intent.temp_id] = opid
-        return op
-
-    def _build_intent_op(self, intent, obj_id: OpId, obj: _Obj) -> Optional[Op]:
-        action = intent.action
-        if obj.is_sequence:
-            if intent.insert:
-                live = self._live_elems(obj)
-                idx = intent.index if intent.index is not None else len(live)
-                if idx < 0 or idx > len(live):
-                    return None
-                ref = HEAD if idx == 0 else live[idx - 1]
-                return Op(
-                    action=action,
-                    obj=obj_id,
-                    ref=ref,
-                    insert=True,
-                    value=intent.value,
-                    datatype=intent.datatype,
-                )
-            live = self._live_elems(obj)
-            if intent.index is None or not (0 <= intent.index < len(live)):
-                return None
-            elem = live[intent.index]
-            visible = obj.fields.get(elem, {})
-            if action == Action.INC:
-                target = max(visible) if visible else None
-                if target is None:
-                    return None
-                return Op(
-                    action=action, obj=obj_id, ref=elem, value=intent.value,
-                    pred=(target,),
-                )
-            return Op(
-                action=action,
-                obj=obj_id,
-                ref=elem,
-                value=intent.value,
-                datatype=intent.datatype,
-                pred=tuple(sorted(visible)),
-            )
-        # map/table
-        visible = obj.fields.get(intent.key, {})
-        if action == Action.INC:
-            target = max(visible) if visible else None
-            if target is None:
-                return None
-            return Op(
-                action=action, obj=obj_id, key=intent.key,
-                value=intent.value, pred=(target,),
-            )
-        return Op(
-            action=action,
-            obj=obj_id,
-            key=intent.key,
-            value=intent.value,
-            datatype=intent.datatype,
-            pred=tuple(sorted(visible)),
+        return resolve_intent(
+            intent, opid, temp_map, self.objects.get, self._live_elems
         )
 
     # ------------------------------------------------------------------

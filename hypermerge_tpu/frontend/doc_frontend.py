@@ -189,6 +189,15 @@ class DocFrontend:
     def on_patch(self, patch_json: Dict, history: int) -> None:
         queued = None
         with self._lock:
+            if self.mode == "pending":
+                # A patch can only precede this doc's Ready in the
+                # queue when the backend announced between emitting the
+                # patch and pushing the Ready — and that Ready snapshot
+                # (computed under the live-engine lock, AFTER every
+                # earlier emission) already contains the patch's
+                # effects. Applying it to the blank doc would corrupt
+                # the baseline and silently poison every later patch.
+                return
             patch = Patch.from_json(patch_json)
             with bench("front:patch"):
                 self.front.apply_patch(patch)

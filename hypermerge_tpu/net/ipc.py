@@ -31,6 +31,68 @@ from typing import Callable, Optional, Tuple
 from .tcp import TcpDuplex
 
 
+class ReplyFence:
+    """Fences one backend's query replies across frontend swaps.
+
+    Persist mode reuses ONE live backend for successive frontends. The
+    swap drains *buffered* messages, but a handler still in flight on
+    another thread (a Materialize query walking a large history, a
+    patch decode) pushes its Reply AFTER the drain — and the next
+    frontend's queryId counter restarts at the same small integers, so
+    a previous frontend's late reply would resolve the wrong promise.
+
+    Inbound Query ids are tagged with the accepting connection's epoch;
+    outbound Replies only pass a gate bound to the same epoch (and are
+    untagged back to the frontend's raw id). A reply produced by an
+    in-flight handler from a previous frontend therefore dies at the
+    gate instead of being delivered cross-session.
+    """
+
+    def __init__(self) -> None:
+        self.epoch = 0
+
+    def advance(self) -> int:
+        self.epoch += 1
+        return self.epoch
+
+    def inbound(self, msg, epoch: int):
+        """Tag a frontend->backend Query with the accepting
+        connection's epoch (the backend echoes queryId opaquely into
+        its Reply). The epoch is bound at accept time, NOT read at
+        dispatch time: a previous connection's reader thread that
+        dispatches a decoded frame after the swap must tag with ITS
+        epoch, so the resulting Reply still dies at the new gate."""
+        if isinstance(msg, dict) and msg.get("type") == "Query":
+            msg = dict(msg)
+            msg["queryId"] = [epoch, msg["queryId"]]
+        return msg
+
+    def outbound(self, epoch: int, msg):
+        """The backend->frontend message for a gate bound to `epoch`,
+        with the raw queryId restored — or None when the Reply belongs
+        to a different frontend session (dropped)."""
+        if isinstance(msg, dict) and msg.get("type") == "Reply":
+            qid = msg.get("queryId")
+            if isinstance(qid, list) and len(qid) == 2:
+                if qid[0] != epoch:
+                    return None  # a previous frontend's late reply
+                msg = dict(msg)
+                msg["queryId"] = qid[1]
+        return msg
+
+    def gate(self, send):
+        """A subscriber for backend.to_frontend bound to the CURRENT
+        epoch: drops other epochs' replies, untags this one's."""
+        epoch = self.epoch
+
+        def fn(msg):
+            out = self.outbound(epoch, msg)
+            if out is not None:
+                send(out)
+
+        return fn
+
+
 def serve_backend(
     sock_path: str,
     repo_path: Optional[str] = None,
@@ -50,7 +112,11 @@ def serve_backend(
         os.remove(sock_path)
     server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     server.bind(sock_path)
-    server.listen(1)
+    # backlog > 1: a probe burst (port scan, health check) must not make
+    # a real frontend's connect fail with EAGAIN while the accept loop
+    # is still tearing down the previous connection (AF_UNIX connect
+    # does not wait for backlog space on Linux)
+    server.listen(8)
     print(f"backend ready on {sock_path}", flush=True)
 
     def build_backend() -> "RepoBackend":
@@ -71,6 +137,9 @@ def serve_backend(
 
     back = build_backend()
     idle_sink = False  # a discard sink is attached between frontends
+    fence = ReplyFence()  # queryIds are epoch-tagged per frontend: a
+    # previous frontend's in-flight handler cannot deliver its late
+    # Reply to the next one (whose queryId counter restarts)
     try:
         while True:
             conn, _ = server.accept()
@@ -88,8 +157,13 @@ def serve_backend(
                 back.to_frontend.unsubscribe()
                 back.to_frontend.drain()
                 idle_sink = False
-            back.subscribe(duplex.send)
-            duplex.on_message(back.receive)
+            epoch = fence.advance()
+            back.subscribe(fence.gate(duplex.send))
+            duplex.on_message(
+                lambda msg, _f=fence, _e=epoch: back.receive(
+                    _f.inbound(msg, _e)
+                )
+            )
             gone = threading.Event()
             duplex.on_close(gone.set)
             gone.wait()

@@ -695,10 +695,10 @@ class ReplicationManager:
                 self._send(peer, payload)
             start = end
 
-    def flush_now(self, timeout: float = 5.0) -> None:
+    def flush_now(self, timeout: float = 5.0) -> bool:
         """Block until every currently-dirty tail has FINISHED
         flushing (tests and orderly shutdown)."""
-        self._flusher.flush_now(timeout)
+        return self._flusher.flush_now(timeout)
 
     def close(self) -> None:
         # drains: tails marked before close still reach peers

@@ -20,6 +20,8 @@ import os
 import socket
 import struct
 import threading
+import time
+from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..utils.debug import log
@@ -27,6 +29,15 @@ from .swarm import ConnectionDetails, Swarm
 
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
+
+
+def _outbox_cap() -> int:
+    """Max bytes queued behind a non-draining peer before the
+    connection sheds (closes). The writer thread removed the old
+    blocking-send backpressure; this cap bounds what replaces it."""
+    return int(
+        float(os.environ.get("HM_TCP_OUTBOX_MB", "64")) * (1 << 20)
+    )
 
 
 class TcpDuplex:
@@ -47,7 +58,22 @@ class TcpDuplex:
         from ..utils.queue import Queue
 
         self._sock = sock
-        self._wlock = threading.Lock()
+        # Outbound frames go through a dedicated writer thread, never
+        # straight to sendall: inbound dispatch runs synchronously on
+        # the reader thread, and a reader that blocks on a full socket
+        # buffer while the peer's reader does the same is a distributed
+        # send deadlock (both sides wedge mid-burst, replication
+        # freezes while the connection still reports open).
+        self._outbox: deque = deque()
+        self._out_cv = threading.Condition()
+        self._out_inflight = False  # frame popped but not yet sent
+        self._out_bytes = 0
+        self._out_cap = _outbox_cap()  # read once: send() is hot
+        self._stall_s = float(os.environ.get("HM_TCP_STALL_S", "10"))
+        self._last_progress = time.monotonic()  # writer's last sendall
+        self._shed = False  # over-cap close: skip the drain wait
+        self._writer_dead = False  # writer hit a send error: no drain
+        self._rx_eof = False  # peer closed/died: draining is pointless
         self._inbox: "Queue" = Queue("tcp:inbox")
         self._on_close: Optional[Callable[[], None]] = None
         self._lock = threading.RLock()
@@ -66,6 +92,10 @@ class TcpDuplex:
                 return
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
+        self._writer = threading.Thread(
+            target=self._write_loop, daemon=True
+        )
+        self._writer.start()
 
     @property
     def channel_binding(self) -> Optional[bytes]:
@@ -153,18 +183,75 @@ class TcpDuplex:
             cb()
 
     def send(self, msg: Any) -> None:
+        """Queue a frame for the writer thread. Never blocks on the
+        socket — see _outbox above. The protocol's ack-paced block
+        streams bound most of what piles up here, but patch/gossip
+        frames are not ack-paced: a peer that stops reading while its
+        socket stays open would otherwise grow the queue without limit.
+        Past HM_TCP_OUTBOX_MB *with the writer stalled* (no completed
+        frame for HM_TCP_STALL_S — a healthy peer absorbing a large
+        burst keeps making progress and is never shed), or past 4x the
+        cap regardless of progress (the hard memory bound: a slow-drip
+        peer must not grow the queue forever), the connection sheds
+        (closes); the peer redials and resyncs from its cursor."""
         if self.closed:
             return
         data = json.dumps(msg, separators=(",", ":")).encode("utf-8")
-        try:
-            with self._wlock:
+        with self._out_cv:
+            if not self._outbox and not self._out_inflight:
+                # idle -> active: the stall clock must measure from the
+                # start of THIS burst, not from the last pre-idle frame
+                self._last_progress = time.monotonic()
+            self._outbox.append(data)
+            self._out_bytes += len(data)
+            over = self._out_bytes > self._out_cap
+            self._out_cv.notify()
+        if over and (
+            self._out_bytes > 4 * self._out_cap
+            or time.monotonic() - self._last_progress > self._stall_s
+        ):
+            log(
+                "net:tcp",
+                f"outbox over cap ({self._out_bytes}B) with a stalled "
+                "writer: peer not draining, shedding connection",
+            )
+            self._shed = True
+            self.close()
+
+    def _write_loop(self) -> None:
+        while True:
+            with self._out_cv:
+                # the previous frame (if any) is fully on the wire only
+                # once we get back here: signal close()'s drain AFTER
+                # sendall, not when the frame is merely popped
+                self._out_inflight = False
+                if not self._outbox:
+                    self._out_cv.notify_all()  # close() may be draining
+                while not self._outbox and not self.closed:
+                    self._out_cv.wait()
+                if not self._outbox:  # closed and drained
+                    return
+                data = self._outbox.popleft()
+                self._out_bytes -= len(data)
+                self._out_inflight = True
+            try:
                 # nonce counters are per-direction and strictly ordered:
-                # encrypt under the same lock that orders the writes
+                # the single writer thread orders encryption and writes
                 if self._session is not None:
                     data = self._session.encrypt(data)
                 self._sock.sendall(_HDR.pack(len(data)) + data)
-        except OSError:
-            self.close()
+                self._last_progress = time.monotonic()
+            except OSError:
+                # signal BEFORE close(): a concurrent closer may be
+                # waiting on the drain cv while holding self._lock —
+                # the frame is lost and the outbox will never drain, so
+                # wake it now instead of letting it burn its deadline
+                with self._out_cv:
+                    self._out_inflight = False
+                    self._writer_dead = True
+                    self._out_cv.notify_all()
+                self.close()
+                return
 
     def _read_exact(self, n: int) -> Optional[bytes]:
         buf = b""
@@ -206,13 +293,40 @@ class TcpDuplex:
             except Exception as e:  # subscriber bug must not kill reader
                 log("net:tcp", f"inbound handler error: {e}")
                 break
+        self._rx_eof = True
         self.close()
 
     def close(self) -> None:
         with self._lock:
             if self.closed:
                 return
+            # orderly close loses nothing: give the writer a bounded
+            # window to drain queued frames. Skip when draining cannot
+            # succeed or has no point: close() running ON the writer
+            # after a send error (socket dead), an over-cap shed (peer
+            # by definition not draining), a writer that already died
+            # in sendall, or a reader EOF (the peer is gone and will
+            # never read queued frames)
+            if (
+                not self._shed
+                and not self._rx_eof
+                and threading.current_thread()
+                is not getattr(self, "_writer", None)
+            ):
+                deadline = 5.0
+                with self._out_cv:
+                    while (
+                        (self._outbox or self._out_inflight)
+                        and not self._writer_dead
+                        and not self._rx_eof  # peer died mid-drain
+                        and deadline > 0
+                    ):
+                        t0 = time.monotonic()
+                        self._out_cv.wait(min(deadline, 0.2))
+                        deadline -= time.monotonic() - t0
             self.closed = True
+        with self._out_cv:
+            self._out_cv.notify_all()  # writer exits
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -249,6 +249,67 @@ def doc_actor_map_from_pairs(
     return out.reshape(Dp, max(A_loc, 1))
 
 
+def _encode_op_row(
+    op,
+    opid: OpId,
+    change: Change,
+    row_of: Dict[OpId, int],
+    actor_ids: _Interner,
+    key_ids: _Interner,
+    str_ids: _Interner,
+    float_ids: _Interner,
+    big_ids: _Interner,
+) -> Optional[Tuple[Dict[str, int], List[int]]]:
+    """Resolve + encode ONE op as ({column: value}, pred target rows).
+    None when the op drops (unknown container/element/INC target — the
+    OpSet tolerance). THE row encoding: `_pack_one` (bulk reference
+    pack) and `LiveColumns._append_one` (live engine appends) both
+    write exactly these values, so the two paths cannot drift."""
+    if op.obj == ROOT:
+        obj_row = -1
+    else:
+        obj_row = row_of.get(op.obj, -4)
+        if obj_row == -4:
+            return None  # container unknown (tolerate, like OpSet)
+    if op.action == Action.INC:
+        target = op.pred[0] if op.pred else None
+        ref_row = row_of.get(target, -3) if target else -3
+        if ref_row == -3:
+            return None
+    elif op.ref is None:
+        ref_row = -3
+    elif op.ref == HEAD:
+        ref_row = -2
+    else:
+        ref_row = row_of.get(op.ref, -4)
+        if ref_row == -4:
+            return None  # unknown element
+    vkind, value = _encode_value(op, str_ids, float_ids, big_ids)
+    vals = {
+        "action": int(op.action),
+        "actor": actor_ids(change.actor),
+        "ctr": opid.ctr,
+        "seq": change.seq,
+        "obj": obj_row,
+        "key": key_ids(op.key) if op.key is not None else -1,
+        "ref": ref_row,
+        "insert": 1 if op.insert else 0,
+        "vkind": vkind,
+        "value": value,
+        "dt": (
+            1 if op.datatype == "counter"
+            else 2 if op.datatype == "timestamp" else 0
+        ),
+    }
+    pred_tgts: List[int] = []
+    if op.action != Action.INC:
+        for p in op.pred:
+            tgt = row_of.get(p)
+            if tgt is not None:
+                pred_tgts.append(tgt)
+    return vals, pred_tgts
+
+
 def _pack_one(
     changes: List[Change],
     actor_ids: _Interner,
@@ -264,47 +325,17 @@ def _pack_one(
     for change in changes:
         for i, op in enumerate(change.ops):
             opid = change.op_id(i)
-            if op.obj == ROOT:
-                obj_row = -1
-            else:
-                obj_row = row_of.get(op.obj, -4)
-                if obj_row == -4:
-                    continue  # container unknown (tolerate, like OpSet)
-            if op.action == Action.INC:
-                target = op.pred[0] if op.pred else None
-                ref_row = row_of.get(target, -3) if target else -3
-                if ref_row == -3:
-                    continue
-            elif op.ref is None:
-                ref_row = -3
-            elif op.ref == HEAD:
-                ref_row = -2
-            else:
-                ref_row = row_of.get(op.ref, -4)
-                if ref_row == -4:
-                    continue  # unknown element
-            vkind, value = _encode_value(
-                op, str_ids, float_ids, big_ids
+            enc = _encode_op_row(
+                op, opid, change, row_of,
+                actor_ids, key_ids, str_ids, float_ids, big_ids,
             )
-            cols["action"].append(int(op.action))
-            cols["actor"].append(actor_ids(change.actor))
-            cols["ctr"].append(opid.ctr)
-            cols["seq"].append(change.seq)
-            cols["obj"].append(obj_row)
-            cols["key"].append(key_ids(op.key) if op.key is not None else -1)
-            cols["ref"].append(ref_row)
-            cols["insert"].append(1 if op.insert else 0)
-            cols["vkind"].append(vkind)
-            cols["value"].append(value)
-            cols["dt"].append(
-                1 if op.datatype == "counter"
-                else 2 if op.datatype == "timestamp" else 0
-            )
-            if op.action != Action.INC:
-                for p in op.pred:
-                    tgt = row_of.get(p)
-                    if tgt is not None:
-                        preds.append((row, tgt))
+            if enc is None:
+                continue
+            vals, pred_tgts = enc
+            for name in COLUMNS:
+                cols[name].append(vals[name])
+            for tgt in pred_tgts:
+                preds.append((row, tgt))
             row_of[opid] = row
             row += 1
     return cols, preds
@@ -1196,6 +1227,212 @@ def _empty_batch(
         bigints=list(big_int.items),
         doc_actors=np.full((D, 1), -1, np.int32),
     )
+
+
+# ---------------------------------------------------------------------------
+# appendable per-doc packed columns (the live apply engine's cache)
+
+
+class LiveColumns:
+    """ONE document's packed op history, appendable in place.
+
+    The live apply engine (backend/live.py) keeps each hot doc's packed
+    columns host-pinned: incoming changes append rows at the tail (no
+    feed IO, no repack of the prefix), and each tick stacks dirty docs'
+    columns into a padded [D, N] batch for the jitted kernels.
+
+    Row encoding is `_pack_one`'s, with persistent state: `row_of`
+    resolves obj/ref/pred references across appends, the interners are
+    per-DOC (the kernels never read table *contents*, only group by
+    index — so no batch-global remap is ever needed), and unresolvable
+    ops drop exactly as `_pack_one` drops them (the OpSet tolerance).
+
+    Row order is arrival order, NOT the causal linear order `pack_docs`
+    emits. The kernels are row-order-independent (winners come from
+    lexsorts over (group, lamport) keys, RGA order from explicit parent
+    pointers), so appending at the tail is always sound; only consumers
+    that assume causally-sorted rows (none on the live path) may not
+    read these columns.
+
+    Actor column values are intern indices; `slots()` maps them through
+    the string-sort rank LUT the kernels tie-break by (recomputed only
+    when a new actor joins).
+    """
+
+    _INIT_CAP = 64
+
+    def __init__(self) -> None:
+        self.n = 0
+        self.n_preds = 0
+        self.cols: Dict[str, np.ndarray] = {
+            name: np.full(
+                self._INIT_CAP, _COL_DEFAULTS.get(name, 0), np.int32
+            )
+            for name in COLUMNS
+        }
+        self.psrc = np.full(self._INIT_CAP, -1, np.int32)
+        self.ptgt = np.full(self._INIT_CAP, -1, np.int32)
+        self.actors = _Interner()
+        self.keys = _Interner()
+        self.strings = _Interner()
+        self.floats = _Interner()
+        self.bigints = _Interner()
+        self.row_of: Dict[OpId, int] = {}
+        self.opids: List[OpId] = []  # row -> OpId (append-only, so the
+        # per-tick decoders reuse it instead of rebuilding O(n) objects)
+        self._rank_lut: Optional[np.ndarray] = None
+
+    @classmethod
+    def from_batch(cls, batch: ColumnarBatch, d: int = 0) -> "LiveColumns":
+        """Adopt one doc's rows out of a packed batch (bulk-loaded docs
+        enter the live engine through this — their history is already
+        packed, so adoption is a column copy plus the row_of index)."""
+        lv = cls()
+        n = int(batch.n_ops[d])
+        lv._reserve_rows(n)
+        for name in COLUMNS:
+            lv.cols[name][:n] = batch.cols[name][d, :n]
+        lv.n = n
+        keep = np.asarray(batch.psrc[d]) >= 0
+        srcs = np.asarray(batch.psrc[d])[keep].astype(np.int32)
+        tgts = np.asarray(batch.ptgt[d])[keep].astype(np.int32)
+        lv._reserve_preds(len(srcs))
+        lv.psrc[: len(srcs)] = srcs
+        lv.ptgt[: len(tgts)] = tgts
+        lv.n_preds = len(srcs)
+        for a in batch.actors:
+            lv.actors(a)
+        for k in batch.keys:
+            lv.keys(k)
+        for s in batch.strings:
+            lv.strings(s)
+        for f in batch.floats:
+            lv.floats(f)
+        for b in batch.bigints:
+            lv.bigints(b)
+        ctr = batch.cols["ctr"][d, :n].tolist()
+        act = batch.cols["actor"][d, :n].tolist()
+        actors = batch.actors
+        lv.opids = [
+            OpId(int(c), actors[a]) for c, a in zip(ctr, act)
+        ]
+        lv.row_of = {opid: i for i, opid in enumerate(lv.opids)}
+        return lv
+
+    # -- appends --------------------------------------------------------
+
+    def append_changes(self, changes: Sequence[Change]) -> None:
+        """Append already-admitted changes (caller enforces causal
+        order + dedup — the live engine's admission mirror of OpSet)."""
+        for change in changes:
+            self._append_one(change)
+
+    def _append_one(self, change: Change) -> None:
+        row_of = self.row_of
+        for i, op in enumerate(change.ops):
+            opid = change.op_id(i)
+            n_actors = len(self.actors.items)
+            enc = _encode_op_row(
+                op, opid, change, row_of,
+                self.actors, self.keys, self.strings, self.floats,
+                self.bigints,
+            )
+            if enc is None:
+                continue
+            if len(self.actors.items) != n_actors:
+                self._rank_lut = None  # new actor: ranks shift
+            vals, pred_tgts = enc
+            row = self.n
+            self._reserve_rows(row + 1)
+            c = self.cols
+            for name in COLUMNS:
+                c[name][row] = vals[name]
+            for tgt in pred_tgts:
+                k = self.n_preds
+                self._reserve_preds(k + 1)
+                self.psrc[k] = row
+                self.ptgt[k] = tgt
+                self.n_preds = k + 1
+            row_of[opid] = row
+            self.opids.append(opid)
+            self.n = row + 1
+
+    def _reserve_rows(self, n: int) -> None:
+        cap = len(self.cols["action"])
+        if n <= cap:
+            return
+        new_cap = round_up_pow2(n)
+        for name in COLUMNS:
+            grown = np.full(
+                new_cap, _COL_DEFAULTS.get(name, 0), np.int32
+            )
+            grown[: self.n] = self.cols[name][: self.n]
+            self.cols[name] = grown
+
+    def _reserve_preds(self, n: int) -> None:
+        cap = len(self.psrc)
+        if n <= cap:
+            return
+        new_cap = round_up_pow2(n)
+        for attr in ("psrc", "ptgt"):
+            grown = np.full(new_cap, -1, np.int32)
+            grown[: self.n_preds] = getattr(self, attr)[: self.n_preds]
+            setattr(self, attr, grown)
+
+    # -- kernel views ---------------------------------------------------
+
+    @property
+    def actor_rank(self) -> np.ndarray:
+        """LUT: actor intern index -> string-sort rank (the kernel's
+        tie-break order)."""
+        if self._rank_lut is None or len(self._rank_lut) != max(
+            1, len(self.actors.items)
+        ):
+            order = sorted(
+                range(len(self.actors.items)),
+                key=lambda i: self.actors.items[i],
+            )
+            lut = np.zeros(max(1, len(self.actors.items)), np.int32)
+            for rank, idx in enumerate(order):
+                lut[idx] = rank
+            self._rank_lut = lut
+        return self._rank_lut
+
+    def slots(self) -> np.ndarray:
+        """[n] int32 actor slots in string-sort rank order."""
+        return self.actor_rank[self.cols["actor"][: self.n]]
+
+    def opid(self, row: int) -> OpId:
+        return OpId(
+            int(self.cols["ctr"][row]),
+            self.actors.items[int(self.cols["actor"][row])],
+        )
+
+    def decode_row_value(self, row: int) -> Any:
+        return decode_live_value(
+            int(self.cols["vkind"][row]),
+            int(self.cols["value"][row]),
+            self,
+        )
+
+
+_COL_DEFAULTS = {"action": PAD, "obj": -1, "key": -1, "ref": -3}
+
+
+def decode_live_value(vkind: int, value: int, lv: "LiveColumns") -> Any:
+    if vkind == VK_NONE:
+        return None
+    if vkind == VK_INT:
+        return int(value)
+    if vkind == VK_BOOL:
+        return bool(value)
+    if vkind == VK_FLOAT:
+        return lv.floats.items[value]
+    if vkind == VK_STR:
+        return lv.strings.items[value]
+    if vkind == VK_BIGINT:
+        return lv.bigints.items[value]
+    raise ValueError(f"bad vkind {vkind}")
 
 
 def decode_value(

@@ -321,6 +321,15 @@ def summary_wire_spec(N: int, A: int, lean: bool) -> Dict[str, int]:
     """Byte layout of the [D, W] summary wire buffer."""
     mask_bytes = (N + 7) // 8
     order_bits = max(1, (N - 1).bit_length())
+    if order_bits > 25:
+        # _unpack_uint gathers at most 4 bytes per value: shift (<=7) +
+        # order_bits must fit a 32-bit window, so entries wider than 25
+        # bits would decode silently truncated. No real bucket is within
+        # two orders of magnitude of 2^25 rows; reject loudly.
+        raise ValueError(
+            f"summary wire bucket too large: N={N} needs "
+            f"{order_bits}-bit order entries, max 25 (N <= 2^25)"
+        )
     order_bytes = (N * order_bits + 7) // 8
     count_bytes = 2 if N < 2**15 else 4
     clock_bytes = 0 if lean else 4 * A
@@ -527,6 +536,36 @@ def materialize_full_lean_device(
         doc_actors,
     )
     return out, _summarize_wire(out, flags.shape[1], A, lean=True)
+
+
+LIVE_MIN_ROWS = 64
+LIVE_MIN_DOCS = 1
+
+
+def live_bucket(n: int, floor: int) -> int:
+    """Pow2 jit bucket with a floor: live tick batches pad their row /
+    doc / actor-slot / key axes to these shapes so a stream of ticks
+    reuses a handful of compiled programs instead of compiling one per
+    exact shape (the same bucketing discipline as the bulk slab path)."""
+    return max(floor, round_up_pow2(max(n, 1)))
+
+
+@partial(jax.jit, static_argnames=("A", "K"))
+def materialize_live_device(
+    flags, slot, ctr, obj, key, ref, value, psrc, ptgt, A: int, K: int
+) -> MaterializeOut:
+    """The live tick entry: materialize_device minus the seq wire and
+    the doc-actor map. The live engine holds authoritative clocks
+    host-side (admission mirrors OpSet's causal gating), so the clock
+    lane is never read — seq uploads nothing and the [D, A] clock
+    output comes back zeros. `value` still rides the wire: live batches
+    may carry INC ops."""
+    _enable_persistent_compile_cache()
+    zeros = jnp.zeros_like(ctr)
+    da = jnp.zeros((flags.shape[0], A), jnp.int32)
+    return batched_kernel(A, K)(
+        flags, slot, ctr, zeros, obj, key, ref, value, psrc, ptgt, da
+    )
 
 
 def ensure_doc_actors(batch: ColumnarBatch):

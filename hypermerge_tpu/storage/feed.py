@@ -381,6 +381,10 @@ class Feed:
             if index not in self._sparse:
                 cap = int(os.environ.get("HM_SPARSE_CAP", "1024"))
                 if len(self._sparse) >= cap:
+                    if not self._sparse:
+                        # cap <= 0: the buffer admits nothing — drop the
+                        # block instead of max() on an empty dict
+                        return False
                     worst = max(self._sparse)
                     if index >= worst:
                         return False  # incoming is the furthest: drop

@@ -91,7 +91,7 @@ class CorpusSlab:
             slab_size = os.path.getsize(self.path)
         except OSError:
             return
-        entries, idx_ok = self._read_index(slab_size)
+        entries, idx_ok, idx_end = self._read_index(slab_size)
         if not idx_ok:
             entries = []
         pos = len(_HDR.pack(_MAGIC, _VERSION))
@@ -106,21 +106,30 @@ class CorpusSlab:
             for kind, name, off, ln in recovered:
                 self._apply(kind, name, off, ln)
             if idx_ok:
+                # a torn partial entry may trail the last good one; drop
+                # it BEFORE appending, or every later open would parse
+                # the fragment as a bogus entry, fail the monotonic
+                # check, and rescan the whole slab
+                self._truncate_idx(idx_end)
                 for e in recovered:
                     self._append_idx(*e)
             else:
                 self._rewrite_idx()
         elif not idx_ok:
             self._rewrite_idx()
+        elif idx_end is not None:
+            self._truncate_idx(idx_end)
 
     def _read_index(self, slab_size: int):
-        """([(kind, name, payload_off, payload_len)], usable) — usable is
-        False when the index is missing or inconsistent with the slab."""
+        """([(kind, name, payload_off, payload_len)], usable, torn_at) —
+        usable is False when the index is missing or inconsistent with
+        the slab; torn_at is the byte offset of a trailing partial entry
+        fragment (None when the file parsed cleanly to its end)."""
         try:
             with open(self.idx_path, "rb") as fh:
                 raw = fh.read()
         except OSError:
-            return [], False
+            return [], False, None
         out = []
         pos = 0
         end = len(raw)
@@ -133,11 +142,22 @@ class CorpusSlab:
             name = raw[p : p + nlen].decode("ascii", "replace")
             off, ln = struct.unpack_from("<QQ", raw, p + nlen)
             if off < prev_end or off + ln > slab_size:
-                return [], False  # inconsistent: rebuild by scan
+                return [], False, None  # inconsistent: rebuild by scan
             out.append((kind, name, off, ln))
             prev_end = off + ln
             pos = p + nlen + 16
-        return out, True
+        return out, True, (pos if pos < end else None)
+
+    def _truncate_idx(self, torn_at: Optional[int]) -> None:
+        """Drop a torn partial entry fragment from the index tail so
+        later appends land on a clean boundary."""
+        if torn_at is None:
+            return
+        try:
+            with open(self.idx_path, "r+b") as fh:
+                fh.truncate(torn_at)
+        except OSError:
+            pass  # read-only media: the fragment stays, scan still heals
 
     def _scan(self, start: int, slab_size: int):
         """Parse slab segment headers in [start, slab_size); stops at a

@@ -15,6 +15,7 @@ SQL aggregation.
 from __future__ import annotations
 
 import math
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..crdt import clock as clockmod
@@ -218,25 +219,58 @@ def jnp_broadcast(q, rows):
 
 
 class CursorStore:
-    """Which actors (and up to what seq) a repo includes in each doc."""
+    """Which actors (and up to what seq) a repo includes in each doc.
+
+    Reads serve from a write-through in-memory mirror (hydrated per
+    repo_id on first touch): cursor lookups sit on the replication hot
+    path (_sync_changes runs docs_with_actor + entry per feed append
+    burst) and a ~1ms SQLite round trip under writer contention there
+    throttles live convergence. SQLite stays the durable copy — every
+    mutation still lands in the table; the mirror merges with the same
+    monotonic max-wins rule as the upsert."""
 
     def __init__(self, db: SqlDatabase) -> None:
         self.db = db
+        self._lock = threading.RLock()
+        # repo_id -> doc_id -> {actor: seq}; repo_id -> actor -> docs
+        self._mem: Dict[str, Dict[str, Dict[str, int]]] = {}
+        self._by_actor: Dict[str, Dict[str, Dict[str, None]]] = {}
+
+    def _repo(self, repo_id: str) -> Dict[str, Dict[str, int]]:
+        """The repo's mirror, hydrating from SQLite on first touch.
+        Caller holds self._lock."""
+        mem = self._mem.get(repo_id)
+        if mem is None:
+            mem = {}
+            by_actor: Dict[str, Dict[str, None]] = {}
+            for doc_id, actor, seq in self.db.query(
+                "SELECT doc_id, actor_id, seq FROM cursors "
+                "WHERE repo_id=?",
+                (repo_id,),
+            ):
+                mem.setdefault(doc_id, {})[actor] = seq
+                by_actor.setdefault(actor, {})[doc_id] = None
+            self._mem[repo_id] = mem
+            self._by_actor[repo_id] = by_actor
+        return mem
+
+    def _absorb(
+        self, repo_id: str, doc_id: str, actor: str, seq: int
+    ) -> None:
+        """Max-wins merge into the mirror (the upsert's twin). Caller
+        holds self._lock."""
+        cur = self._repo(repo_id).setdefault(doc_id, {})
+        if actor not in cur or seq > cur[actor]:
+            cur[actor] = seq
+        self._by_actor[repo_id].setdefault(actor, {})[doc_id] = None
 
     def get(self, repo_id: str, doc_id: str) -> clockmod.Clock:
-        rows = self.db.query(
-            "SELECT actor_id, seq FROM cursors WHERE repo_id=? AND doc_id=?",
-            (repo_id, doc_id),
-        )
-        return {a: s for a, s in rows}
+        with self._lock:
+            return dict(self._repo(repo_id).get(doc_id, {}))
 
     def entry(self, repo_id: str, doc_id: str, actor_id: str) -> int:
-        rows = self.db.query(
-            "SELECT seq FROM cursors "
-            "WHERE repo_id=? AND doc_id=? AND actor_id=?",
-            (repo_id, doc_id, actor_id),
-        )
-        return rows[0][0] if rows else 0
+        with self._lock:
+            return self._repo(repo_id).get(doc_id, {}).get(actor_id, 0)
 
     def update(
         self, repo_id: str, doc_id: str, clock: clockmod.Clock
@@ -248,7 +282,27 @@ class CursorStore:
             "SET seq=excluded.seq WHERE excluded.seq > seq",
             [(repo_id, doc_id, a, _clamp(s)) for a, s in clock.items()],
         )
-        return self.get(repo_id, doc_id)
+        with self._lock:
+            for a, s in clock.items():
+                self._absorb(repo_id, doc_id, a, _clamp(s))
+            return dict(self._repo(repo_id).get(doc_id, {}))
+
+    def update_many_rows(
+        self, repo_id: str, rows: Iterable[Tuple[str, str, int]]
+    ) -> None:
+        """Monotonic merge of (doc_id, actor_id, seq) rows in one
+        statement, no read-back (the debounced live-path store flush)."""
+        rows = list(rows)
+        self.db.executemany(
+            "INSERT INTO cursors (repo_id, doc_id, actor_id, seq) "
+            "VALUES (?,?,?,?) "
+            "ON CONFLICT (repo_id, doc_id, actor_id) DO UPDATE "
+            "SET seq=excluded.seq WHERE excluded.seq > seq",
+            [(repo_id, d, a, _clamp(s)) for d, a, s in rows],
+        )
+        with self._lock:
+            for d, a, s in rows:
+                self._absorb(repo_id, d, a, _clamp(s))
 
     def add_actor(
         self, repo_id: str, doc_id: str, actor_id: str,
@@ -260,6 +314,7 @@ class CursorStore:
         self, repo_id: str, entries, seq: float = math.inf
     ) -> None:
         """add_actor for many (doc_id, actor_id) pairs in one statement."""
+        entries = list(entries)
         s = _clamp(seq)
         self.db.executemany(
             "INSERT INTO cursors (repo_id, doc_id, actor_id, seq) "
@@ -268,36 +323,23 @@ class CursorStore:
             "SET seq=excluded.seq WHERE excluded.seq > seq",
             [(repo_id, d, a, s) for d, a in entries],
         )
+        with self._lock:
+            for d, a in entries:
+                self._absorb(repo_id, d, a, s)
 
     def get_multiple(
         self, repo_id: str, doc_ids: Iterable[str]
     ) -> Dict[str, clockmod.Clock]:
-        """Cursors for many docs in chunked IN queries (one bulk load =
-        a handful of SELECTs, not one per doc)."""
+        """Cursors for many docs in one pass over the mirror."""
         ids = list(doc_ids)
-        out: Dict[str, clockmod.Clock] = {d: {} for d in ids}
-        # 500 params per statement: safe under every SQLite build's
-        # SQLITE_MAX_VARIABLE_NUMBER (999 before 3.32)
-        for base in range(0, len(ids), 500):
-            chunk = ids[base : base + 500]
-            marks = ",".join("?" for _ in chunk)
-            rows = self.db.query(
-                f"SELECT doc_id, actor_id, seq FROM cursors "
-                f"WHERE repo_id=? AND doc_id IN ({marks})",
-                (repo_id, *chunk),
-            )
-            for doc_id, actor, seq in rows:
-                out[doc_id][actor] = seq
-        return out
+        with self._lock:
+            mem = self._repo(repo_id)
+            return {d: dict(mem.get(d, {})) for d in ids}
 
     def docs_with_actor(self, repo_id: str, actor_id: str) -> List[str]:
-        return [
-            r[0]
-            for r in self.db.query(
-                "SELECT doc_id FROM cursors WHERE repo_id=? AND actor_id=?",
-                (repo_id, actor_id),
-            )
-        ]
+        with self._lock:
+            self._repo(repo_id)
+            return list(self._by_actor[repo_id].get(actor_id, ()))
 
     def actors_for(self, repo_id: str, doc_id: str) -> List[str]:
         return list(self.get(repo_id, doc_id).keys())
@@ -307,6 +349,11 @@ class CursorStore:
             "DELETE FROM cursors WHERE repo_id=? AND doc_id=?",
             (repo_id, doc_id),
         )
+        with self._lock:
+            if repo_id in self._mem:
+                self._mem[repo_id].pop(doc_id, None)
+                for docs in self._by_actor[repo_id].values():
+                    docs.pop(doc_id, None)
 
 
 class KeyStore:

@@ -1,0 +1,390 @@
+"""Live apply engine (backend/live.py) — the HM_LIVE=1/0 twin contract.
+
+The live path routes incremental changes on lazy (bulk-loaded) docs
+through per-tick batched kernel dispatches; HM_LIVE=0 is the host-OpSet
+correctness twin. Pinned here:
+
+- no host replay: the deferred loader is NEVER invoked for live
+  local/remote changes (the acceptance bar for the batched live path);
+- fuzz twin: a randomized multi-actor workload (concurrent maps,
+  lists, text, counters, deletes, nested objects, cross-site merges)
+  delivered in BOTH orders produces bit-identical local patch echoes,
+  clocks, snapshot patches, and frontend state across HM_LIVE=1/0;
+- LiveColumns: appending a change stream incrementally decodes to the
+  same state as packing the full history.
+"""
+
+import os
+import random
+import shutil
+import tempfile
+
+import pytest
+
+from helpers import Site, plainify, random_mutation, sync, wait_until
+from hypermerge_tpu.models import Text
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.utils.ids import validate_doc_url
+
+
+@pytest.fixture
+def live_env(monkeypatch):
+    monkeypatch.setenv("HM_LIVE", "1")
+
+
+def _seed_dir(tmp, n_changes=6, seed=7):
+    """A stored single-writer doc on disk + its history snapshot."""
+    repo = Repo(path=tmp)
+    url = repo.create({"edits": [], "t": Text("hi")})
+    r = random.Random(seed)
+    for _ in range(n_changes):
+        repo.change(url, lambda d: d["edits"].append(r.randint(0, 99)))
+    repo.change(url, lambda d: d["t"].insert(2, "!"))
+    doc_id = validate_doc_url(url)
+    stored = list(repo.back.docs[doc_id].opset.history)
+    repo.close()
+    return url, doc_id, stored
+
+
+def test_live_path_never_invokes_lazy_loader(tmp_path, live_env):
+    """Acceptance: no full host replay on the first live change to a
+    bulk-loaded doc — local AND remote."""
+    from hypermerge_tpu.crdt.change import Action, Change, Op, ROOT
+
+    url, doc_id, stored = _seed_dir(str(tmp_path))
+    repo = Repo(path=str(tmp_path))
+    repo.back.load_documents_bulk([doc_id])
+    doc = repo.back.docs[doc_id]
+    assert doc.opset is None and doc._lazy_loader is not None
+
+    calls = []
+    orig = doc._lazy_loader
+
+    def spy():
+        calls.append(1)
+        return orig()
+
+    doc._lazy_loader = spy
+
+    # local change: resolves through the engine, no replay
+    repo.change(url, lambda d: d.__setitem__("new", 1))
+    assert repo.doc(url)["new"] == 1
+    assert doc.opset is None and not calls
+
+    # remote change from another actor: ticks through the engine
+    peer = Site("peerpeerpeer0001")
+    peer.receive(stored + [c for c in _local_changes(repo, doc_id)])
+    ch, _ = peer.change(lambda d: d.__setitem__("remote", 2))
+    doc.apply_remote_changes([ch])
+    wait_until(lambda: repo.doc(url).get("remote") == 2)
+    assert doc.opset is None and not calls
+
+    # explicit history APIs still replay (and don't corrupt live state)
+    hist = doc.materialize_at(doc.history_len)
+    assert plainify(hist)["new"] == 1
+    assert calls, "time travel should use the host replay"
+    assert doc.opset is None
+    repo.close()
+
+
+def _local_changes(repo, doc_id):
+    """The doc's applied changes as Change objects (from the feeds)."""
+    out = []
+    for actor_id, end in repo.back.docs[doc_id].clock.items():
+        actor = repo.back._get_or_create_actor(actor_id)
+        out.extend(actor.changes_in_window(0, end))
+    return out
+
+
+def _gen_remote_script(stored, seed, n_rounds=10):
+    """Deterministic multi-actor change batches extending `stored`:
+    two peers mutate concurrently and merge periodically."""
+    r = random.Random(seed)
+    peers = [Site(f"peer{i:1d}0000000000001") for i in range(2)]
+    for p in peers:
+        p.receive(stored)
+    script = []  # [(peer_idx, [Change, ...])]
+    for rnd in range(n_rounds):
+        idx = r.randrange(2)
+        site = peers[idx]
+        batch = []
+        for _ in range(r.randint(1, 3)):
+            before = len(site.opset.history)
+            random_mutation(site, r)
+            batch.extend(site.opset.history[before:])
+        if batch:
+            script.append((idx, batch))
+        if rnd % 3 == 2:
+            sync(*peers)
+    return script
+
+
+def _run_workload(base_dir, live, order_flip, seed=13):
+    """Replay the same remote script + local edits against a copy of
+    the seeded repo under HM_LIVE=`live`; returns the observable
+    outcome (local patch echoes, clock, snapshot, frontend state)."""
+    os.environ["HM_LIVE"] = live
+    work = tempfile.mkdtemp()
+    shutil.rmtree(work)
+    shutil.copytree(base_dir, work)
+    try:
+        repo = Repo(path=work)
+        with open(os.path.join(base_dir, "_meta")) as fh:
+            url, doc_id = fh.read().split()
+        local_patches = []
+        orig_push = repo.back.to_frontend.push
+
+        def record(msg):
+            if msg.get("type") == "Patch" and msg["patch"].get("actor"):
+                local_patches.append(msg["patch"])
+            orig_push(msg)
+
+        repo.back.to_frontend.push = record
+        h = repo.open(url)
+        assert h.value(timeout=20) is not None
+        doc = repo.back.docs[doc_id]
+        stored = _local_changes(repo, doc_id)
+        script = _gen_remote_script(stored, seed)
+        if order_flip:
+            # deliver each peer's stream order-preserved, but peer 1's
+            # batches first — later batches park on unmet deps until
+            # the other peer's stream arrives (both paths must park
+            # identically)
+            script = [b for b in script if b[0] == 1] + [
+                b for b in script if b[0] == 0
+            ]
+        # an OpSet oracle tracks exactly which changes are applicable
+        # after each delivery (parking semantics included), so the two
+        # modes pause at identical states before each local edit
+        from hypermerge_tpu.crdt.opset import OpSet
+
+        oracle = OpSet()
+        oracle.apply_changes(stored)
+        peer_actors = set()
+        for k, (_idx, batch) in enumerate(script):
+            oracle.apply_changes(list(batch))
+            peer_actors.update(c.actor for c in batch)
+            doc.apply_remote_changes(list(batch))
+            wait_until(
+                lambda: all(
+                    doc.clock.get(a, 0) == oracle.clock.get(a, 0)
+                    for a in peer_actors
+                )
+            )
+            # interleaved local edits (state-shape-independent)
+            repo.change(url, lambda d, k=k: d.__setitem__(f"k{k}", k))
+            repo.change(
+                url, lambda d, k=k: d["edits"].append(1000 + k)
+            )
+        if repo.back.live is not None:
+            repo.back.live.flush_now()
+        import json
+
+        outcome = {
+            "snap": doc.snapshot_patch().to_json(),
+            "clock": dict(doc.clock),
+            "hist": doc.history_len,
+            "state": plainify(h.value()),
+            "local_patches": local_patches,
+        }
+        # the writable actor is minted fresh per reopen (its key is not
+        # in the doc url): normalize it BEFORE the sorted dump, so key
+        # ordering can't differ between runs
+        actor_id = doc.actor_id
+        repo.close()
+
+        def scrub(v):
+            if isinstance(v, str):
+                return v.replace(actor_id, "<LOCAL-ACTOR>")
+            if isinstance(v, dict):
+                return {scrub(k): scrub(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [scrub(x) for x in v]
+            return v
+
+        return json.dumps(scrub(outcome), sort_keys=True, default=str)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+@pytest.mark.parametrize("order_flip", [False, True], ids=["fwd", "rev"])
+def test_live_twin_fuzz_bit_identical(tmp_path, order_flip):
+    """HM_LIVE=1 and HM_LIVE=0 produce bit-identical local patch
+    echoes, clocks, snapshot patches, and frontend state on a
+    randomized multi-actor workload, in both delivery orders."""
+    base = str(tmp_path / "seed")
+    os.makedirs(base)
+    old = os.environ.get("HM_LIVE")
+    try:
+        os.environ["HM_LIVE"] = "0"
+        url, doc_id, _stored = _seed_dir(base)
+        with open(os.path.join(base, "_meta"), "w") as fh:
+            fh.write(f"{url} {doc_id}")
+        host = _run_workload(base, "0", order_flip)
+        live = _run_workload(base, "1", order_flip)
+    finally:
+        if old is None:
+            os.environ.pop("HM_LIVE", None)
+        else:
+            os.environ["HM_LIVE"] = old
+    # ONE normalized comparison covers clocks, history length, frontend
+    # state, the snapshot patch, and every local patch echo
+    # patch-for-patch (the live engine's local resolution mirrors
+    # OpSet.apply_local_request; `time` never appears in patches)
+    assert live == host
+
+
+def test_live_columns_append_matches_full_pack():
+    """Appending a causal change stream to LiveColumns decodes to the
+    same state as adopting the fully packed history (the no-repack
+    invariant of the live cache) — and both match the OpSet snapshot."""
+    from hypermerge_tpu.backend.live import (
+        _decode_state,
+        _diff_states,
+        _DocState,
+    )
+    from hypermerge_tpu.ops.columnar import (
+        LiveColumns,
+        causal_sort,
+        pack_docs,
+    )
+
+    for seed in range(4):
+        r = random.Random(seed * 991)
+        sites = [Site(f"s{i}000000000001") for i in range(3)]
+        for _ in range(25):
+            random_mutation(r.choice(sites), r)
+            if r.random() < 0.3:
+                sync(*sites)
+        sync(*sites)
+        changes = causal_sort(
+            [c for s in sites for c in s.opset.history]
+        )
+
+        incremental = LiveColumns()
+        incremental.append_changes(changes)
+        batch = pack_docs([changes])
+        adopted = LiveColumns.from_batch(batch, 0)
+
+        def state_of(lv):
+            return _decode_state(lv, _run_host(lv))
+
+        s_inc = state_of(incremental)
+        s_full = state_of(adopted)
+        d_inc = [d.to_json() for d in _diff_states(_DocState(), s_inc)]
+        d_full = [
+            d.to_json() for d in _diff_states(_DocState(), s_full)
+        ]
+        assert d_inc == d_full
+        # ...and both agree with the host OpSet snapshot
+        opset = sites[0].opset
+        want = [d.to_json() for d in opset.snapshot_patch().diffs]
+        assert d_inc == want
+
+
+def test_diff_states_streams_detached_object_updates():
+    """Kernel-tick deltas must include mutations to objects the
+    frontend still holds but that are currently DETACHED (a concurrent
+    winner displaced their link). The host path streams those diffs
+    (FrontendDoc retains detached objects and applies them), so a
+    later re-attach links a CURRENT copy — dropping them would leave
+    the live frontend stale and diverge from the HM_LIVE=0 twin."""
+    from hypermerge_tpu.backend.live import (
+        _diff_states,
+        _DocState,
+        _Obj,
+        _Val,
+    )
+    from hypermerge_tpu.crdt.change import ROOT, OpId
+
+    x = OpId(1, "actorA")
+
+    def mk_state(x_val):
+        st = _DocState()
+        st.objs[x] = _Obj("map")
+        st.objs[x].fields["inner"] = {
+            OpId(2, "actorA"): _Val(x_val, False, None)
+        }
+        # root key 'a' holds the SET that displaced X's link
+        st.objs[ROOT].fields["a"] = {
+            OpId(3, "actorB"): _Val(5, False, None)
+        }
+        return st
+
+    old = mk_state("old")
+    new = mk_state("new")
+    old.reachable = {ROOT, x}  # frontend got X before the detach
+    diffs = _diff_states(old, new)
+    assert any(
+        d.action == "set" and d.obj == str(x) and d.value == "new"
+        for d in diffs
+    ), [d.to_json() for d in diffs]
+    assert x in new.reachable  # successive ticks keep streaming it
+
+
+def _run_host(lv):
+    import numpy as np
+
+    from hypermerge_tpu.ops.host_kernel import _host_doc_kernel
+
+    n = lv.n
+    A = max(1, len(lv.actors.items))
+    K = max(1, len(lv.keys.items))
+    c = lv.cols
+    return _host_doc_kernel(
+        c["action"][:n], lv.slots(), c["ctr"][:n],
+        np.zeros(n, np.int32), c["obj"][:n], c["key"][:n],
+        c["ref"][:n], c["insert"][:n], c["value"][:n],
+        lv.psrc[: lv.n_preds], lv.ptgt[: lv.n_preds],
+        np.arange(A, dtype=np.int32), A, K,
+    )
+
+
+def test_live_reopen_serves_fresh_snapshot(tmp_path, live_env):
+    """A handle reopened on a live-adopted doc gets the CURRENT state
+    (the engine's snapshot twin), not the stale bulk-load decode."""
+    url, doc_id, _ = _seed_dir(str(tmp_path))
+    repo = Repo(path=str(tmp_path))
+    h1 = repo.open(url)
+    assert h1.value(timeout=20) is not None
+    repo.change(url, lambda d: d.__setitem__("fresh", True))
+    h1.close()
+    repo.back.close_doc(doc_id)  # drop doc + live state entirely
+    h2 = repo.open(url)
+    wait_until(lambda: (h2.value(timeout=5) or {}).get("fresh"))
+    repo.close()
+
+
+def test_live_tick_batches_multiple_docs(tmp_path, live_env):
+    """A burst across several lazy docs coalesces into shared ticks
+    (the O(ticks) dispatch claim, visible in the engine stats)."""
+    repo = Repo(path=str(tmp_path))
+    urls = [repo.create({"i": i, "edits": []}) for i in range(6)]
+    ids = [validate_doc_url(u) for u in urls]
+    stored = {
+        i: _local_changes(repo, ids[i]) for i in range(len(urls))
+    }
+    repo.close()
+
+    repo2 = Repo(path=str(tmp_path))
+    repo2.back.load_documents_bulk(ids)
+    peers = []
+    for i, did in enumerate(ids):
+        p = Site(f"burst{i:1d}000000001")
+        p.receive(stored[i])
+        peers.append(p)
+    # one coalesced burst: every doc gets a remote change in the same
+    # tick window
+    for i, did in enumerate(ids):
+        ch, _ = peers[i].change(lambda d, i=i: d.__setitem__("r", i))
+        repo2.back.docs[did].apply_remote_changes([ch])
+    repo2.back.live.flush_now()
+    for i, u in enumerate(urls):
+        wait_until(lambda i=i, u=u: repo2.doc(u).get("r") == i)
+    stats = repo2.back.live.stats
+    assert stats["adopted"] == len(urls)
+    assert stats["tick_changes"] >= len(urls)
+    assert stats["ticks"] <= stats["tick_changes"], stats
+    for did in ids:
+        assert repo2.back.docs[did].opset is None
+    repo2.close()

@@ -491,6 +491,18 @@ class TestSparseFetch:
         f.put_sparse(11, b"s11")
         assert sorted(f._sparse) == [5, 10, 11, 12]
 
+    def test_sparse_cap_zero_drops_instead_of_crashing(self, monkeypatch):
+        """HM_SPARSE_CAP<=0 disables the buffer: put_sparse must report
+        the drop (False), not raise max() on an empty dict."""
+        monkeypatch.setenv("HM_SPARSE_CAP", "0")
+        feeds = FeedStore(memory_storage_fn)
+        f = feeds.create(keymod.create())
+        assert f.put_sparse(3, b"s3") is False
+        assert f._sparse == {}
+        # blocks the contiguous log already holds still report True
+        f.append(b"real0")
+        assert f.put_sparse(0, b"dup") is True
+
 
 class TestJoinOptions:
     """Discovery asymmetry (VERDICT r5 item 9; reference
@@ -586,6 +598,56 @@ class TestTcp:
         assert ra.doc(url) == {"over": "tcp", "back": True}
         ra.close()
         rb.close()
+
+    def test_non_draining_peer_sheds_connection(self, monkeypatch):
+        """The writer thread removed blocking-send backpressure; a peer
+        that stops reading while its socket stays open must shed the
+        connection at HM_TCP_OUTBOX_MB, not grow the outbox forever."""
+        import socket as sockmod
+        import time
+
+        from hypermerge_tpu.net.tcp import TcpDuplex
+
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_TCP_OUTBOX_MB", "0.01")  # ~10 KB
+        monkeypatch.setenv("HM_TCP_STALL_S", "0.2")
+        a, b = sockmod.socketpair()
+        # tiny kernel buffers so the writer wedges in sendall quickly
+        a.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_SNDBUF, 4096)
+        b.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_RCVBUF, 4096)
+        d = TcpDuplex(a)
+        payload = {"pad": "x" * 4096}
+        deadline = time.time() + 10
+        while not d.closed and time.time() < deadline:
+            d.send(payload)
+        assert d.closed, "outbox grew past the cap without shedding"
+        b.close()
+
+    def test_close_with_wedged_writer_is_prompt(self, monkeypatch):
+        """A peer that dies with a frame wedged in sendall must not
+        make close() burn its full 5s drain deadline: reader EOF and a
+        dead writer both short-circuit the drain wait."""
+        import socket as sockmod
+        import time
+
+        from hypermerge_tpu.net.tcp import TcpDuplex
+
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        a, b = sockmod.socketpair()
+        a.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_SNDBUF, 4096)
+        b.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_RCVBUF, 4096)
+        d = TcpDuplex(a)
+        payload = {"pad": "x" * 4096}
+        for _ in range(64):  # wedge the writer, queue a backlog
+            d.send(payload)
+        t0 = time.monotonic()
+        b.close()  # peer dies: frames queued + one mid-sendall
+        deadline = time.monotonic() + 10
+        while not d.closed and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert d.closed
+        d.close()  # idempotent, and must return promptly too
+        assert time.monotonic() - t0 < 3.0, "close stalled on drain"
 
 
 class TestChurn:

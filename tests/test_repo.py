@@ -190,8 +190,40 @@ def test_clockstore_updates(repo):
     url = repo.create({"x": 1})
     repo.change(url, lambda d: d.__setitem__("x", 2))
     doc_id = validate_doc_url(url)
+    # clock rows flush debounced (one executemany per burst): settle it
+    repo.back._stores.flush_now()
     stored = repo.back.clocks.get(repo.back.id, doc_id)
     assert stored == {doc_id: 2}
+
+
+def test_store_debounce_off_writes_cursor_rows_synchronously():
+    """HM_STORE_DEBOUNCE=0 is the correctness twin for the r8 store
+    coalescing: BOTH clock and cursor rows must land synchronously,
+    with nothing left inside the debouncer — otherwise bisecting a
+    store-coalescing bug with the knob off doesn't reproduce the
+    pre-debounce behavior."""
+    import os
+
+    os.environ["HM_STORE_DEBOUNCE"] = "0"
+    try:
+        repo = Repo(memory=True)
+        back = repo.back
+        marks = []
+        orig_mark = back._stores.mark
+        back._stores.mark = lambda *a, **kw: (
+            marks.append(a), orig_mark(*a, **kw)
+        )
+        url = repo.create({"x": 1})
+        repo.change(url, lambda d: d.__setitem__("x", 2))
+        doc_id = validate_doc_url(url)
+        # neither clock ("c") nor cursor ("u") rows went through the
+        # debouncer...
+        assert marks == []
+        # ...and the rows are already durable, no flush needed
+        assert back.clocks.get(back.id, doc_id) == {doc_id: 2}
+        repo.close()
+    finally:
+        del os.environ["HM_STORE_DEBOUNCE"]
 
 
 def test_debug_info(repo):

@@ -177,10 +177,11 @@ def test_missing_or_torn_index_rebuilds(tmp_path):
     # (a feed-grouped dump would fail the monotonic check and force a
     # full slab scan on EVERY open)
     probe = CorpusSlab(str(tmp_path / "cols.slab"))
-    entries, usable = probe._read_index(
+    entries, usable, torn_at = probe._read_index(
         os.path.getsize(tmp_path / "cols.slab")
     )
     assert usable and entries, "rebuilt index rejected on reopen"
+    assert torn_at is None
     probe.close()
 
     # torn index tail: truncate mid-entry
@@ -215,6 +216,39 @@ def test_index_repairs_forward_after_lost_entry(tmp_path):
     assert np.array_equal(cc.columns().ensure_rows(), want)
     cc.close()
     fn.slab.close()
+
+
+def test_index_repair_truncates_torn_fragment_first(tmp_path):
+    """Crash model: the slab append landed, the index append tore
+    mid-entry. Repair-forward must TRUNCATE the torn fragment before
+    appending the recovered entries — otherwise every later open parses
+    the fragment as a bogus entry, fails the monotonic check, and
+    rescans the whole slab forever."""
+    want = _fill(tmp_path, names=("feedA",), seed=5)["feedA"]
+    slab = CorpusSlab(str(tmp_path / "cols.slab"))
+    idx_before = (tmp_path / "cols.slab.idx").read_bytes()
+    slab.append(KIND_IMAGE, "feedZ", b"HMc3" + b"\x00" * 16)
+    slab.close()
+    # torn idx: the old entries plus HALF of feedZ's entry bytes
+    idx_after = (tmp_path / "cols.slab.idx").read_bytes()
+    frag = idx_after[len(idx_before) : len(idx_before) + 9]
+    (tmp_path / "cols.slab.idx").write_bytes(idx_before + frag)
+
+    slab2 = CorpusSlab(str(tmp_path / "cols.slab"))
+    assert slab2.feed_live("feedZ"), "unindexed segment not recovered"
+    assert slab2.feed_live("feedA")
+    slab2.close()
+
+    # the healed index must parse CLEANLY on the next open — all
+    # entries usable, no torn fragment, no full-slab rescan
+    slab3 = CorpusSlab(str(tmp_path / "cols.slab"))
+    entries, usable, torn_at = slab3._read_index(
+        os.path.getsize(tmp_path / "cols.slab")
+    )
+    assert usable and torn_at is None
+    assert {name for _k, name, _o, _l in entries} == {"feedA", "feedZ"}
+    assert slab3.feed_live("feedZ") and slab3.feed_live("feedA")
+    slab3.close()
 
 
 def test_tombstone_and_compaction_reclaim(tmp_path, monkeypatch):

@@ -35,6 +35,17 @@ def test_wire_spec_totals():
     assert spec["total"] == 128 + 128 + 1280 + 2 + 2 + 16
 
 
+def test_wire_spec_rejects_untruncatable_order_bits():
+    """_unpack_uint gathers at most 4 bytes per entry: order entries
+    wider than 25 bits (N > 2^25 rows) would decode silently truncated
+    — the spec must reject them loudly, exactly at the boundary."""
+    # the largest legal bucket: order_bits == 25
+    spec = ck.summary_wire_spec(2**25, 4, lean=True)
+    assert spec["order_bits"] == 25
+    with pytest.raises(ValueError, match="2\\^25"):
+        ck.summary_wire_spec(2**25 + 1, 4, lean=True)
+
+
 def test_wire_matches_host_reference_summary():
     """Device wire -> parse == decode_columnar on the same batch (incl.
     clocks on the non-lean wire)."""

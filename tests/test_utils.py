@@ -299,6 +299,26 @@ class TestDebouncer:
         t.join(5)
         d.close()
 
+    def test_flush_now_reports_timeout(self):
+        """flush_now returns False when the drain did not finish inside
+        the timeout — destroy() relies on this to refuse deleting rows
+        a late flush would resurrect — and True once it has."""
+        import threading as _th
+
+        from hypermerge_tpu.utils.debounce import Debouncer
+
+        release = _th.Event()
+
+        def stuck_flush(batch):
+            release.wait(5)
+
+        d = Debouncer(stuck_flush, window_s=0.0)
+        d.mark("k")
+        assert d.flush_now(timeout=0.05) is False
+        release.set()
+        assert d.flush_now(timeout=5) is True
+        d.close()
+
 
 def test_debouncer_adaptive_window_stretches_under_load():
     """With max_window_s set, a slow flush stretches the next window so
