@@ -263,6 +263,72 @@ def _config6_live_burst(n_ops=8192, n_burst=256):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _config6_demote_readopt(n_ops=4096, n_docs=3, rounds=3):
+    """Demote -> re-edit cycle (the HM_LIVE_MAX_BYTES lifecycle): N
+    stored text docs open lazily, each takes a live local edit
+    (adopt); a byte cap below one doc's footprint demotes every idle
+    doc after its tick, so each round-robin edit RE-adopts a demoted
+    doc from its sidecars. Reports the median re-adoption edit latency
+    (ms) and the engine's demote/readopt counters — the trajectory
+    metric for the byte-bounded live engine."""
+    import tempfile as _tf
+    import time as _t
+
+    from hypermerge_tpu.models import Text
+    from hypermerge_tpu.repo import Repo
+
+    tmp = _tf.mkdtemp(prefix="hm_dem6")
+    old = os.environ.get("HM_LIVE_MAX_BYTES")
+    repo2 = None
+    try:
+        repo = Repo(path=tmp)
+        urls = []
+        chunk = 64
+        for _i in range(n_docs):
+            url = repo.create({"t": ""})
+            repo.change(url, lambda d: d.__setitem__("t", Text("seed")))
+            for _base in range(0, n_ops, chunk):
+                repo.change(
+                    url,
+                    lambda d: d["t"].insert(len(d["t"]), "x" * chunk),
+                )
+            urls.append(url)
+        repo.close()
+
+        os.environ["HM_LIVE_MAX_BYTES"] = "1"  # only the MRU survives
+        repo2 = Repo(path=tmp)
+        handles = repo2.open_many(urls)
+        for h in handles:
+            assert h.value(timeout=60) is not None
+        eng = repo2.back.live
+        if eng is None:
+            return None  # HM_LIVE=0: no lifecycle to measure
+        for u in urls:  # round 0: first adoption of every doc
+            repo2.change(u, lambda d: d["t"].insert(len(d["t"]), "!"))
+            eng.flush_now()
+        lats = []
+        for _rnd in range(rounds):
+            for u in urls:
+                t0 = _t.perf_counter()
+                repo2.change(
+                    u, lambda d: d["t"].insert(len(d["t"]), "?")
+                )
+                lats.append((_t.perf_counter() - t0) * 1e3)
+                eng.flush_now()  # tick + budget pass demotes the rest
+        lats.sort()
+        stats = _live_stats(repo2)
+        assert stats.get("readopted", 0) >= rounds * (n_docs - 1), stats
+        return lats[len(lats) // 2], stats
+    finally:
+        if repo2 is not None:
+            repo2.close()
+        if old is None:
+            os.environ.pop("HM_LIVE_MAX_BYTES", None)
+        else:
+            os.environ["HM_LIVE_MAX_BYTES"] = old
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _config5_union(n_docs=100_000, n_actors=64, seed=0, dirty=1000):
     """100k-doc clock union served from the device-RESIDENT ClockStore
     mirror (ops/clock_mirror.py; BASELINE config 5). Setup uploads the
@@ -603,10 +669,31 @@ def main() -> None:
             print(f"# config2 live-apply: {cfg2[2]}", file=sys.stderr)
     cfg6l = _soft("config6_live", _config6_live_burst)
     if cfg6l is not None:
+        st6 = cfg6l[2]
         print(
             f"# config6-live single-doc burst: first edit "
             f"{cfg6l[0]:.0f}ms, burst {cfg6l[1]:,.0f} edits/s "
-            f"(live stats {cfg6l[2]})",
+            f"(live stats {st6})",
+            file=sys.stderr,
+        )
+        print(
+            "# config6-live adoption stages (ms): "
+            + ", ".join(
+                f"{k[8:]}={st6.get(k, 0.0) * 1e3:.1f}"
+                for k in (
+                    "t_adopt_pack", "t_adopt_kernel", "t_adopt_decode",
+                    "t_adopt_reach", "t_adopt_lock_free",
+                    "t_adopt_lock_held",
+                )
+            ),
+            file=sys.stderr,
+        )
+    cfg6d = _soft("config6_demote", _config6_demote_readopt)
+    if cfg6d is not None:
+        print(
+            f"# config6-demote lifecycle: re-adopt edit median "
+            f"{cfg6d[0]:.1f}ms (demoted {cfg6d[1].get('demoted', 0)}, "
+            f"readopted {cfg6d[1].get('readopted', 0)})",
             file=sys.stderr,
         )
     cfg3 = _soft("config3", _config3_multiactor)
@@ -677,6 +764,19 @@ def main() -> None:
                     ),
                     "config6_live": (
                         cfg6l[2] if cfg6l is not None else None
+                    ),
+                    "config6_live_adopt_decode_ms": (
+                        round(
+                            cfg6l[2].get("t_adopt_decode", 0.0) * 1e3, 1
+                        )
+                        if cfg6l is not None
+                        else None
+                    ),
+                    "config6_demote_readopt_ms": (
+                        round(cfg6d[0], 1) if cfg6d is not None else None
+                    ),
+                    "config6_demote": (
+                        cfg6d[1] if cfg6d is not None else None
                     ),
                     "config3_multiactor_ops_per_s": (
                         round(cfg3[1]) if cfg3 is not None else None

@@ -163,6 +163,28 @@ class DocBackend:
                         ]
                     self.opset.apply_changes(changes)
 
+    def demote_from_live(
+        self,
+        clock: clockmod.Clock,
+        history_len: int,
+        snapshot_fn: Callable[[], Any],
+    ) -> None:
+        """The live engine demoted this doc back to the lazy path (the
+        byte-bounded LRU, backend/live.py): the engine's clock/length
+        become the lazy serving state, and every cached artifact of the
+        OLD state (bulk-load snapshot, replay memo) is dropped — the
+        doc may have changed since they were computed. `snapshot_fn`
+        rebuilds a CURRENT Ready/reopen snapshot from the sidecars on
+        demand. The lazy loader stays, so the next live change
+        re-adopts."""
+        with self._lock:
+            self._live_adopted = False
+            self._lazy_clock = dict(clock)
+            self._lazy_len = history_len
+            self._snapshot_cache = None
+            self._snapshot_fn = snapshot_fn
+            self._replay_cache = None
+
     def set_actor_id(self, actor_id: str) -> None:
         with self._lock:
             self.actor_id = actor_id

@@ -13,6 +13,19 @@ materialize_live_device, or its numpy twin below the device-min-cells
 threshold). A burst of N edits across M docs costs O(ticks) device
 programs, not O(N) Python replays.
 
+Adoption (a bulk-loaded doc going hot) is lock-free: the O(doc) build
+(pack from sidecars, exact-size host kernel, lane-driven vectorized
+decode, winner-lane reachability) runs WITHOUT the engine lock —
+other hot docs keep ticking — and installs under it with a recheck
+(opset still None, serving clock unmoved, doc still open). The engine
+lock remains the ONE emission lock: every {compute patch -> push}
+pair holds it; the build computes no patch, so it is the one O(doc)
+stage allowed outside. HM_LIVE_MAX_BYTES byte-bounds resident
+LiveColumns: least-recently-ticked idle docs demote back to the lazy
+path after a tick and re-adopt from the sidecars on their next live
+change (demotion refuses docs whose state the sidecars cannot
+rebuild).
+
 Twin semantics (HM_LIVE=0 keeps the host-OpSet path):
 - causal admission (seq continuity + deps) mirrors OpSet's pending set
   change-for-change, so clocks are bit-identical;
@@ -32,10 +45,13 @@ time-travel APIs (DocBackend.materialize_at / history_patch).
 
 from __future__ import annotations
 
+import gc
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Set, Tuple
+from contextlib import contextmanager
+from itertools import repeat
+from typing import Any, Dict, List, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
@@ -81,15 +97,14 @@ def _inc_budget_cells() -> int:
 # decoded doc state (OpId space — stable across repacks/ticks)
 
 
-class _Val:
-    """One visible value op at a location."""
+class _Val(NamedTuple):
+    """One visible value op at a location. A NamedTuple: the decode
+    builds one per visible row (hundreds of thousands on adoption) and
+    tuple construction runs in C — same argument as OpId."""
 
-    __slots__ = ("base", "link", "datatype")
-
-    def __init__(self, base, link, datatype) -> None:
-        self.base = base
-        self.link = link
-        self.datatype = datatype
+    base: Any
+    link: bool
+    datatype: Any
 
 
 class _Obj:
@@ -153,10 +168,23 @@ def _display(state: _DocState, cell: Dict[OpId, _Val]):
 # ---------------------------------------------------------------------------
 # state decode from kernel lanes
 
+_DT_NAME = (None, "counter", "timestamp")
+_OBJ_TYPE_BY_CODE = tuple(
+    OBJ_TYPE_BY_MAKE[Action(a)] for a in range(4)
+)
+
 
 def _decode_state(lv: LiveColumns, lanes) -> _DocState:
     """Rebuild the decoded doc state from one kernel run over `lv`'s
-    rows (visible/elem_live/rank/inc_total lanes, [n])."""
+    rows (visible/elem_live/rank/inc_total lanes, [n]).
+
+    Lane-driven: np.nonzero/lexsort batch passes plus the vectorized
+    value decode (`LiveColumns.decode_values`) replace the old per-row
+    Python loops — one _Val is pre-built per visible row (each row
+    contributes to exactly one cell), containers resolve through a
+    memo, and element order lands as one run-sliced list per container
+    instead of an append per row. Bit-identical to the row-loop
+    decode it replaced (pinned against OpSet in tests/test_live.py)."""
     n = lv.n
     state = _DocState()
     if n == 0:
@@ -169,64 +197,212 @@ def _decode_state(lv: LiveColumns, lanes) -> _DocState:
     ref_col = c["ref"][:n]
     insert_col = c["insert"][:n]
     dt_col = c["dt"][:n]
-    visible = lanes.visible[:n]
+    visible = np.asarray(lanes.visible[:n]).astype(bool, copy=False)
     rank = lanes.rank[:n]
     inc_total = lanes.inc_total[:n]
 
     # objects (dead MAKEs included — OpSet retains them)
     objs = state.objs
-    for r in np.nonzero(action <= 3)[0].tolist():
-        objs[opids[r]] = _Obj(OBJ_TYPE_BY_MAKE[Action(int(action[r]))])
+    make_rows = np.nonzero(action <= 3)[0]
+    if len(make_rows):
+        types = _OBJ_TYPE_BY_CODE
+        for r, a in zip(
+            make_rows.tolist(), action[make_rows].tolist()
+        ):
+            objs[opids[r]] = _Obj(types[a])
 
-    for r in np.nonzero(inc_total != 0)[0].tolist():
-        state.inc[opids[r]] = int(inc_total[r])
-
-    def val_of(r: int) -> _Val:
-        a = int(action[r])
-        if a <= 3:
-            return _Val(None, True, None)
-        dt = int(dt_col[r])
-        datatype = (
-            "counter" if dt == 1 else "timestamp" if dt == 2 else None
+    inc_rows = np.nonzero(inc_total != 0)[0]
+    if len(inc_rows):
+        state.inc = dict(
+            zip(
+                [opids[r] for r in inc_rows.tolist()],
+                inc_total[inc_rows].tolist(),
+            )
         )
-        return _Val(lv.decode_row_value(r), False, datatype)
 
-    def container(r: int) -> _Obj:
-        o = int(obj_col[r])
-        return objs[ROOT] if o < 0 else objs[opids[o]]
-
-    # map cells: all visible ops with a key, grouped by (container, key)
-    keys_items = lv.keys.items
-    for r in np.nonzero(visible & (key_col >= 0))[0].tolist():
-        obj = container(r)
-        obj.fields.setdefault(keys_items[int(key_col[r])], {})[
-            opids[r]
-        ] = val_of(r)
-
-    # element cells: own insert values + non-insert elem updates
-    for r in np.nonzero(visible & (insert_col == 1))[0].tolist():
-        obj = container(r)
-        obj.fields.setdefault(opids[r], {})[opids[r]] = val_of(r)
-    for r in np.nonzero(
-        visible & (insert_col == 0) & (key_col < 0) & (ref_col >= 0)
-    )[0].tolist():
-        obj = container(r)
-        elem = opids[int(ref_col[r])]
-        obj.fields.setdefault(elem, {})[opids[r]] = val_of(r)
-
-    # full element order (descending rank within each container),
-    # tombstones INCLUDED — OpSet keeps dead elems in `order` (remote
-    # RGA inserts reference them; the skip-scan walks them), and the
-    # incremental tick path mirrors OpSet op-for-op
+    # full element order FIRST (descending rank within each container,
+    # tombstones INCLUDED — OpSet keeps dead elems in `order`: remote
+    # RGA inserts reference them and the skip-scan walks them), with
+    # the per-elem cell dicts prefilled so the visible-row pass below
+    # assigns straight into them. lexsort is stable, so within a
+    # container ties keep row order — the same sequence the global
+    # stable -rank argsort + per-row append produced.
     ins_rows = np.nonzero(insert_col == 1)[0]
     if len(ins_rows):
-        ins_rows = ins_rows[np.argsort(-rank[ins_rows], kind="stable")]
-        for r in ins_rows.tolist():
-            obj = container(r)
-            e = opids[r]
-            obj.order.append(e)
-            obj.fields.setdefault(e, {})
+        o_ins = obj_col[ins_rows]
+        order = np.lexsort((-rank[ins_rows], o_ins))
+        sorted_rows = ins_rows[order].tolist()
+        o_sorted = o_ins[order]
+        bounds = np.nonzero(o_sorted[1:] != o_sorted[:-1])[0] + 1
+        starts = np.concatenate(([0], bounds)).tolist()
+        ends = np.concatenate((bounds, [len(sorted_rows)])).tolist()
+        o_list = o_sorted.tolist()
+        for s, e in zip(starts, ends):
+            o = o_list[s]
+            obj = objs[ROOT] if o < 0 else objs[opids[o]]
+            elems = [opids[r] for r in sorted_rows[s:e]]
+            obj.order = elems
+            fields = obj.fields
+            if fields:
+                for el in elems:
+                    if el not in fields:
+                        fields[el] = {}
+            else:
+                obj.fields = {el: {} for el in elems}
+
+    vis_rows = np.nonzero(visible)[0]
+    if len(vis_rows):
+        # one _Val per visible row, built in a single batch pass (each
+        # row contributes to exactly one cell)
+        bases = lv.decode_values(vis_rows)
+        dts = dt_col[vis_rows]
+        link_rows = np.nonzero(action[vis_rows] <= 3)[0]
+        if dts.any() or len(link_rows):
+            dt_name = _DT_NAME
+            vals = list(
+                map(
+                    _Val._make,
+                    zip(
+                        bases,
+                        repeat(False),
+                        map(dt_name.__getitem__, dts.tolist()),
+                    ),
+                )
+            )
+            link_val = _Val(None, True, None)
+            for j in link_rows.tolist():
+                vals[j] = link_val
+        else:  # no datatypes, no links: the dominant value shape
+            vals = list(
+                map(_Val._make, zip(bases, repeat(False), repeat(None)))
+            )
+        # container per visible row (memoized: rows repeat containers)
+        root_obj = objs[ROOT]
+        cont_of: Dict[int, _Obj] = {}
+        conts: List[_Obj] = []
+        ap = conts.append
+        for o in obj_col[vis_rows].tolist():
+            co = cont_of.get(o)
+            if co is None:
+                co = root_obj if o < 0 else objs[opids[o]]
+                cont_of[o] = co
+            ap(co)
+
+        vr = vis_rows.tolist()
+        kv = key_col[vis_rows]
+        iv = insert_col[vis_rows]
+        rv = ref_col[vis_rows]
+        kvl = kv.tolist()
+        rvl = rv.tolist()
+        # map cells: visible ops with a key, grouped by (container, key)
+        keys_items = lv.keys.items
+        for j in np.nonzero(kv >= 0)[0].tolist():
+            conts[j].fields.setdefault(keys_items[kvl[j]], {})[
+                opids[vr[j]]
+            ] = vals[j]
+        # element cells: own insert values (their cell dicts exist —
+        # every insert row is in its container's prefilled order) +
+        # non-insert elem updates
+        for j in np.nonzero(iv == 1)[0].tolist():
+            e = opids[vr[j]]
+            conts[j].fields[e][e] = vals[j]
+        for j in np.nonzero(
+            (iv == 0) & (kv < 0) & (rv >= 0)
+        )[0].tolist():
+            conts[j].fields.setdefault(opids[rvl[j]], {})[
+                opids[vr[j]]
+            ] = vals[j]
     return state
+
+
+_gc_pause_lock = threading.Lock()
+_gc_pause_depth = 0
+_gc_pause_was_on = False
+
+
+@contextmanager
+def _gc_paused():
+    """Pause the cyclic GC across a bulk decode: building a doc's
+    state allocates O(rows) small objects (_Vals, cell dicts) and the
+    gen0 scans those allocations trigger were ~half the decode wall
+    time. Depth-counted so concurrent lock-free adoption builds nest;
+    never re-enables a GC the application had off."""
+    global _gc_pause_depth, _gc_pause_was_on
+    with _gc_pause_lock:
+        _gc_pause_depth += 1
+        if _gc_pause_depth == 1:
+            _gc_pause_was_on = gc.isenabled()
+            gc.disable()
+    try:
+        yield
+    finally:
+        with _gc_pause_lock:
+            _gc_pause_depth -= 1
+            if _gc_pause_depth == 0 and _gc_pause_was_on:
+                gc.enable()
+
+
+def _reachable_from_lanes(lv: LiveColumns, out) -> Set[OpId]:
+    """Winner-link closure from ROOT, straight from the kernel's
+    map_winner/elem_winner lanes (adoption has the host kernel's full
+    lane set in hand): a MAKE row that wins its cell is a link edge
+    container->child, every row wins at most one cell, so the edges
+    form a forest walked in O(makes). Bit-identical to
+    _compute_reachable's state walk (pinned in tests/test_live.py)."""
+    n = lv.n
+    if n == 0:
+        return {ROOT}
+    action = lv.cols["action"][:n]
+    winner = (
+        np.asarray(out.map_winner)[:n]
+        | np.asarray(out.elem_winner)[:n]
+    )
+    link_rows = np.nonzero(winner & (action <= 3))[0]
+    children: Dict[int, List[int]] = {}
+    obj_col = lv.cols["obj"][:n]
+    for r, p in zip(link_rows.tolist(), obj_col[link_rows].tolist()):
+        children.setdefault(p, []).append(r)
+    seen: Set[int] = set()
+    stack = [-1]  # obj sentinel for ROOT
+    while stack:
+        for r in children.get(stack.pop(), ()):
+            if r not in seen:
+                seen.add(r)
+                stack.append(r)
+    opids = lv.opids
+    reach = {opids[r] for r in seen}
+    reach.add(ROOT)
+    return reach
+
+
+def _compute_reachable(state: _DocState) -> None:
+    """Set `state.reachable` to the winner-link closure from ROOT —
+    exactly the set `_diff_states(_DocState(), state)` would record,
+    without building any Diff/Conflict objects (the adoption path only
+    needs the baseline reachability; the full snapshot diff walk was
+    the single biggest adoption cost)."""
+    objs = state.objs
+    reach: Set[OpId] = {ROOT}
+    stack: List[OpId] = [ROOT]
+    while stack:
+        obj = objs[stack.pop()]
+        if obj.is_sequence:
+            fields = obj.fields
+            cells = [
+                c_ for c_ in (fields.get(e) for e in obj.order) if c_
+            ]
+        else:
+            cells = [c_ for c_ in obj.fields.values() if c_]
+        for cell in cells:
+            winner = max(cell)
+            if (
+                cell[winner].link
+                and winner not in reach
+                and winner in objs
+            ):
+                reach.add(winner)
+                stack.append(winner)
+    state.reachable = reach
 
 
 # ---------------------------------------------------------------------------
@@ -388,7 +564,7 @@ def _diff_states(old: _DocState, new: _DocState) -> List[Diff]:
 class _LiveDoc:
     __slots__ = (
         "doc", "cols", "state", "clock", "max_op", "history_len",
-        "pending", "queued",
+        "pending", "queued", "last_use", "demotable_at",
     )
 
     def __init__(self, doc, cols, state, clock, max_op, history_len):
@@ -400,6 +576,39 @@ class _LiveDoc:
         self.history_len: int = history_len
         self.pending: Dict[Tuple[str, int], Change] = {}
         self.queued: List[Change] = []
+        self.last_use: int = 0  # engine use-clock (LRU demotion order)
+        # demotability memo: (serving clock at last check, verdict) —
+        # the sidecar serveability scan costs IO under the engine
+        # lock, so it runs at most once per clock value
+        self.demotable_at: Optional[Tuple[Dict[str, int], bool]] = None
+
+    def resident_bytes(self) -> int:
+        """Host bytes this hot doc pins: the packed columns plus an
+        estimate of the decoded state (~one _Val + dict slot per
+        row)."""
+        return self.cols.nbytes + self.cols.n * 120
+
+
+class _AdoptGate:
+    """In-flight adoption marker: the builder thread constructs the
+    doc's live state OUTSIDE the engine lock; other threads submitting
+    changes for the same doc wait on `event` instead of replaying the
+    doc host-side (and instead of serializing behind the engine lock,
+    which stays free for other docs' ticks)."""
+
+    __slots__ = ("thread", "event", "outcome")
+
+    def __init__(self) -> None:
+        self.thread = threading.current_thread()
+        self.event = threading.Event()
+        self.outcome = "refused"
+
+
+def _live_max_bytes() -> int:
+    """HM_LIVE_MAX_BYTES: resident-bytes cap across all adopted docs'
+    LiveColumns (0 / unset = unbounded). Read per enforcement pass so
+    tests and operators can adjust it live."""
+    return int(os.environ.get("HM_LIVE_MAX_BYTES", "0"))
 
 
 class LiveApplyEngine:
@@ -418,16 +627,26 @@ class LiveApplyEngine:
         # against.
         self._docs: Dict[str, _LiveDoc] = {}
         self._refused: Set[str] = set()  # adoption failed: host path
-        self._adopting: Set[str] = set()  # re-entrancy guard: opening
-        # a cursor actor during adoption can replay a window back into
-        # the same doc before its _LiveDoc is registered
+        # in-flight adoptions (doc_id -> gate). Builds run OUTSIDE the
+        # engine lock; the gate both blocks same-doc submitters and
+        # guards the recursive window (opening a cursor actor during
+        # adoption can replay a window back into the same doc on the
+        # builder thread before its _LiveDoc is registered).
+        self._adopting: Dict[str, _AdoptGate] = {}
+        self._demoted_ids: Set[str] = set()  # for the readopted stat
+        self._use_clock = 0  # monotone LRU counter (engine lock)
         self.stats: Dict[str, Any] = {
             "adopted": 0, "refused": 0, "ticks": 0, "tick_docs": 0,
             "tick_changes": 0, "inc_changes": 0, "kernel_runs": 0,
             "device_dispatches": 0, "local_changes": 0,
+            "adopt_retries": 0, "demoted": 0, "readopted": 0,
+            "live_bytes": 0, "live_docs": 0,
             "t_live_append": 0.0, "t_live_apply": 0.0,
             "t_live_kernel": 0.0, "t_live_decode": 0.0,
             "t_live_diff": 0.0,
+            "t_adopt_pack": 0.0, "t_adopt_kernel": 0.0,
+            "t_adopt_decode": 0.0, "t_adopt_reach": 0.0,
+            "t_adopt_lock_free": 0.0, "t_adopt_lock_held": 0.0,
         }
         self._ticker = Debouncer(
             self._on_tick,
@@ -451,14 +670,20 @@ class LiveApplyEngine:
 
     def submit_remote(self, doc, changes: List[Change]) -> bool:
         """Admit + queue remote changes for the next tick. False when
-        the doc cannot be live-managed (caller takes the host path)."""
-        with self._lock:
-            ld = self._ensure_doc(doc)
-            if ld is None:
+        the doc cannot be live-managed (caller takes the host path).
+        Adoption (if needed) builds outside the engine lock."""
+        while True:
+            if self._ensure_doc(doc) is None:
                 return False
-            if self._admit(ld, changes):
-                self._sync_doc_meta(ld)
-                self._ticker.mark(doc.id)
+            with self._lock:
+                ld = self._docs.get(doc.id)
+                if ld is None:
+                    continue  # demoted in the gap: re-adopt
+                ld.last_use = self._bump_use()
+                if self._admit(ld, changes):
+                    self._sync_doc_meta(ld)
+                    self._ticker.mark(doc.id)
+                break
         doc._check_ready()
         return True
 
@@ -474,31 +699,36 @@ class LiveApplyEngine:
         change, so its push must reach the frontend queue before any
         tick emits a delta on the post-change state — same ordering
         contract as send_ready_atomic."""
-        with self._lock:
-            ld = self._ensure_doc(doc)
-            if ld is None:
+        while True:
+            if self._ensure_doc(doc) is None:
                 return None
-            # pending admitted remotes apply (and notify) first, so the
-            # local resolution sees the same state the host path would
-            self._flush_ids([doc.id])
-            # the flush may have evicted the doc to the host path
-            # (_evict_to_host pops it and rebuilds the OpSet) — the old
-            # _LiveDoc is orphaned; the caller retries host-side
-            ld = self._docs.get(doc.id)
-            if ld is None:
-                return None
-            expected = ld.clock.get(req.actor, 0) + 1
-            if req.seq != expected:
-                raise ValueError(
-                    f"out-of-order local change: seq {req.seq} != "
-                    f"{expected}"
-                )
-            change, patch = self._apply_local_locked(ld, req)
-            self._sync_doc_meta(ld)
-            self.stats["local_changes"] += 1
-            if emit is not None:
-                emit(change, patch)
-        return change, patch
+            with self._lock:
+                ld = self._docs.get(doc.id)
+                if ld is None:
+                    continue  # demoted in the gap: re-adopt
+                ld.last_use = self._bump_use()
+                # pending admitted remotes apply (and notify) first, so
+                # the local resolution sees the same state the host
+                # path would
+                self._flush_ids([doc.id])
+                # the flush may have evicted the doc to the host path
+                # (_evict_to_host pops it and rebuilds the OpSet) — the
+                # old _LiveDoc is orphaned; the caller retries host-side
+                ld = self._docs.get(doc.id)
+                if ld is None:
+                    return None
+                expected = ld.clock.get(req.actor, 0) + 1
+                if req.seq != expected:
+                    raise ValueError(
+                        f"out-of-order local change: seq {req.seq} != "
+                        f"{expected}"
+                    )
+                change, patch = self._apply_local_locked(ld, req)
+                self._sync_doc_meta(ld)
+                self.stats["local_changes"] += 1
+                if emit is not None:
+                    emit(change, patch)
+            return change, patch
 
     def snapshot_patch(self, doc) -> Optional[Patch]:
         """From-scratch patch of the live state (OpSet.snapshot_patch
@@ -534,8 +764,10 @@ class LiveApplyEngine:
 
         Docs the engine does not own snapshot host-side via
         `host_snapshot()` — ALSO under the engine lock, which blocks a
-        concurrent adoption (it needs this lock) from ticking a delta
-        between the snapshot and the push. With the engine on, the
+        concurrent adoption (its INSTALL, and any tick after it, needs
+        this lock — the lock-free build alone cannot emit) from
+        ticking a delta between the snapshot and the push. With the
+        engine on, the
         engine lock IS the host-path emission lock too (DocBackend
         routes its {compute -> push} pairs through emission_lock), so
         holding it here serializes against host-path emissions as
@@ -556,6 +788,7 @@ class LiveApplyEngine:
         with self._lock:
             self._docs.pop(doc_id, None)
             self._refused.discard(doc_id)
+            self._demoted_ids.discard(doc_id)
 
     def flush_now(self, timeout: float = 5.0) -> bool:
         return self._ticker.flush_now(timeout)
@@ -564,75 +797,285 @@ class LiveApplyEngine:
         self._ticker.close()
 
     # ------------------------------------------------------------------
-    # adoption
+    # adoption (lock-free build + install-and-recheck)
+
+    def _bump_use(self) -> int:
+        """Next LRU use-clock value. Caller holds the engine lock."""
+        self._use_clock += 1
+        return self._use_clock
 
     def _ensure_doc(self, doc) -> Optional[_LiveDoc]:
-        ld = self._docs.get(doc.id)
-        if ld is not None:
-            return ld
-        if doc.id in self._refused:
-            return None
-        if doc.id in self._adopting:
-            return None  # recursive window during adoption: host path
-        self._adopting.add(doc.id)
-        try:
-            ld = self._adopt(doc)
-        finally:
-            self._adopting.discard(doc.id)
-        if ld is None:
-            self._refused.add(doc.id)
-            self.stats["refused"] += 1
-            # doc._live stays SET: _emission_lock must keep returning
-            # the engine lock for this doc's host-path emissions, or a
-            # refused doc's patches and its engine-locked Ready
-            # (send_ready_atomic) would be guarded by different locks
-            # and could interleave. The host path is still taken — the
-            # opset the fallback installs short-circuits the live
-            # branch, and _refused rejects re-adoption.
-        return ld
+        """The doc's live state, adopting it if needed. MUST be called
+        WITHOUT the engine lock held: the adoption build (pack + kernel
+        + decode, O(doc)) runs lock-FREE so other hot docs keep ticking
+        through the window, then installs under the lock with a recheck
+        (opset still None, serving clock unmoved, doc still open). The
+        ONE-emission-lock invariant holds because the build never
+        computes or pushes a patch — only the install (and every
+        emission) takes the engine lock. Returns None for the host
+        path (refused, recursive adoption window, emission re-entry,
+        or doc closed)."""
+        # a thread that already HOLDS the emission lock (a frontend
+        # callback dispatched synchronously from a push re-entered the
+        # repo mid-emission) must neither build here (an O(doc) build
+        # under the lock is the stall this rework removes) nor wait on
+        # another thread's gate (that builder needs this lock to
+        # install/finish — waiting with it held deadlocks every
+        # emission in the repo). Host path instead, the same answer as
+        # the recursive-window case below.
+        held = getattr(self._lock, "_is_owned", lambda: False)()
+        while True:
+            with self._lock:
+                ld = self._docs.get(doc.id)
+                if ld is not None:
+                    return ld
+                if doc.id in self._refused:
+                    return None
+                if held:
+                    return None
+                gate = self._adopting.get(doc.id)
+                if gate is None:
+                    gate = self._adopting[doc.id] = _AdoptGate()
+                elif gate.thread is threading.current_thread():
+                    # recursive window during our own build (opening a
+                    # cursor actor can replay into this doc): host path
+                    return None
+            if gate.thread is threading.current_thread():
+                break  # we are the builder
+            gate.event.wait()
+            if gate.outcome == "dropped":
+                return None  # doc closed mid-build
+            # else loop: reads installed/refused state (or re-adopts
+            # if a demotion raced the install)
 
-    def _adopt(self, doc) -> Optional[_LiveDoc]:
-        """Build the doc's cached columns + decoded state from its feed
-        sidecars at its SERVING clock — no host OpSet replay. None when
-        a feed can't serve the window (non-contiguous seqs)."""
+        outcome = "refused"
+        ld = None
+        now = time.perf_counter
+        t0 = now()
+        held0 = self.stats["t_adopt_lock_held"]
+        try:
+            for _attempt in range(3):
+                built = self._adopt_build(doc)
+                if built is None:
+                    break
+                status, ld = self._install_adoption(doc, *built)
+                if status == "retry":
+                    # serving clock moved during the build (a host-path
+                    # emission raced in): discard and rebuild
+                    with self._lock:
+                        self.stats["adopt_retries"] += 1
+                    continue
+                outcome = status
+                break
+        finally:
+            with self._lock:
+                self._adopting.pop(doc.id, None)
+                gate.outcome = outcome
+                if outcome == "refused":
+                    self._refused.add(doc.id)
+                    self.stats["refused"] += 1
+                    # doc._live stays SET: _emission_lock must keep
+                    # returning the engine lock for this doc's host-path
+                    # emissions, or a refused doc's patches and its
+                    # engine-locked Ready (send_ready_atomic) would be
+                    # guarded by different locks and could interleave.
+                    # The host path is still taken — the opset the
+                    # fallback installs short-circuits the live branch,
+                    # and _refused rejects re-adoption.
+                # the install window is lock-HELD: keep the two stats
+                # disjoint so lock_free + lock_held = build wall
+                self.stats["t_adopt_lock_free"] = round(
+                    self.stats["t_adopt_lock_free"]
+                    + (now() - t0)
+                    - (self.stats["t_adopt_lock_held"] - held0),
+                    6,
+                )
+            gate.event.set()
+        return ld if outcome == "ok" else None
+
+    def _adopt_build(self, doc) -> Optional[Tuple[_LiveDoc, Dict]]:
+        """Build a doc's cached columns + decoded state from its feed
+        sidecars at its SERVING clock — no host OpSet replay, and NO
+        engine lock. Returns (_LiveDoc, clock) ready for the install
+        recheck, or None to refuse (missing/short/non-contiguous feed,
+        kernel range overflow, or a host OpSet already appeared)."""
         from ..ops.columnar import pack_docs_columns
-        from ..ops.host_kernel import run_batch_host
 
         back = self._back
+        now = time.perf_counter
         with doc._lock:
             if doc.opset is not None or doc._lazy_loader is None:
                 return None
             clock = dict(doc._lazy_clock or {})
             history_len = doc._lazy_len
-        spec = []
-        for actor_id, end in clock.items():
-            if end <= 0:
-                continue
-            actor = back._get_or_create_actor(actor_id)
-            fc = actor.columns()
-            if not fc.seqs_contiguous() or fc.n_changes < end:
-                return None
-            spec.append((fc, 0, end))
-        batch = pack_docs_columns([spec] if spec else [[]])
-        lv = LiveColumns.from_batch(batch, 0)
-        if not self._ranges_ok(lv):
-            return None  # refuse BEFORE paying the kernel run
-        lanes = run_batch_host(batch)
-        state = _decode_state(lv, _LaneView(lanes, 0))
-        # the frontend's baseline is the Ready snapshot of this exact
-        # state: the snapshot walk computes what it can reach
-        _diff_states(_DocState(), state)  # sets state.reachable
-        with doc._lock:
-            if doc.opset is not None:
-                return None  # raced a host-side init: host wins
-            doc._live_adopted = True
+        t0 = now()
+        # the shared serveability rule (non-creating: a refused
+        # adoption must not materialize an empty actor feed on disk)
+        spec = back._serveable_spec(clock)
+        if spec is None:
+            return None
+        with _gc_paused():
+            batch = pack_docs_columns([spec] if spec else [[]])
+            lv = LiveColumns.from_batch(batch, 0)
+            t1 = now()
+            if not self._ranges_ok(lv):
+                return None  # refuse BEFORE paying the kernel run
+            # kernel over the UNPADDED rows (the tick path's per-doc
+            # host kernel): adoption sizes sit just under a pow2
+            # bucket, so the padded batch kernel does ~2x the work
+            lanes = self._host_lanes(lv)
+            t2 = now()
+            state = _decode_state(lv, lanes)
+            t3 = now()
+            # the frontend's baseline is the Ready snapshot of this
+            # exact state: record what that snapshot walk can reach
+            # (winner-link closure from the kernel lanes — no Diff
+            # emission needed)
+            state.reachable = _reachable_from_lanes(lv, lanes)
+            t4 = now()  # inside the pause: the deferred gen0 sweep at
+            # re-enable charges the build total, not the reach stage
+        with self._lock:
+            s = self.stats
+            s["t_adopt_pack"] = round(s["t_adopt_pack"] + t1 - t0, 6)
+            s["t_adopt_kernel"] = round(s["t_adopt_kernel"] + t2 - t1, 6)
+            s["t_adopt_decode"] = round(s["t_adopt_decode"] + t3 - t2, 6)
+            s["t_adopt_reach"] = round(s["t_adopt_reach"] + t4 - t3, 6)
         ld = _LiveDoc(
             doc, lv, state, clock,
             int(batch.cols["ctr"][0].max(initial=0)), history_len,
         )
-        self._docs[doc.id] = ld
-        self.stats["adopted"] += 1
-        return ld
+        return ld, clock
+
+    def _install_adoption(self, doc, ld, clock):
+        """Install a built _LiveDoc under the engine lock, rechecking
+        the state the build was derived from. Returns (status, ld):
+        'ok' (installed), 'retry' (serving clock moved — rebuild),
+        'refused' (a host OpSet won the race), or 'dropped' (the doc
+        was closed/destroyed mid-build)."""
+        now = time.perf_counter
+        t0 = now()
+        with self._lock:
+            with doc._lock:
+                if doc.opset is not None:
+                    return "refused", None  # host-side init won
+                if self._back.docs.get(doc.id) is not doc:
+                    return "dropped", None
+                if dict(doc._lazy_clock or {}) != clock:
+                    return "retry", None
+                doc._live_adopted = True
+            ld.last_use = self._bump_use()
+            self._docs[doc.id] = ld
+            self.stats["adopted"] += 1
+            if doc.id in self._demoted_ids:
+                self._demoted_ids.discard(doc.id)
+                self.stats["readopted"] += 1
+            self._enforce_budget_locked()
+            self.stats["t_adopt_lock_held"] = round(
+                self.stats["t_adopt_lock_held"] + now() - t0, 6
+            )
+        return "ok", ld
+
+    # ------------------------------------------------------------------
+    # byte-bounded LRU demotion (HM_LIVE_MAX_BYTES)
+
+    def _enforce_budget_locked(self) -> None:
+        """Demote least-recently-used idle docs until resident bytes
+        fit HM_LIVE_MAX_BYTES (0 = unbounded — the pass costs O(1)
+        then; `live_bytes` only refreshes while a cap is set). The
+        most recently used doc is never demoted by this pass — a
+        single hot doc larger than the cap must not thrash an O(doc)
+        adopt/demote cycle on every tick — so the effective floor is
+        one doc's bytes. Dirty docs (queued/pending changes) wait for
+        their tick. Caller holds the engine lock."""
+        cap = _live_max_bytes()
+        if cap <= 0:
+            self.stats["live_docs"] = len(self._docs)
+            return
+        self._demote_pass(cap, protect_mru=True)
+
+    def demote_idle(self, max_bytes: Optional[int] = None) -> int:
+        """Demote idle adopted docs (LRU-first) until resident bytes
+        fit `max_bytes` (default: the HM_LIVE_MAX_BYTES cap — a no-op
+        when unset; pass 0 to demote every idle doc). Unlike the
+        automatic budget pass this may demote the most recently used
+        doc too. Returns the number demoted — docs with un-ticked
+        changes, or whose state cannot be rebuilt from the sidecars,
+        stay resident."""
+        with self._lock:
+            if max_bytes is not None:
+                cap = max_bytes
+            else:
+                cap = _live_max_bytes()
+                if cap <= 0:
+                    return 0  # unbounded cap: nothing to enforce
+            return self._demote_pass(cap, protect_mru=False)
+
+    def _demote_pass(self, cap: int, protect_mru: bool) -> int:
+        """ONE LRU demotion sweep shared by the per-tick budget pass
+        (protect_mru=True) and the explicit demote_idle hook; returns
+        the number demoted. Caller holds the engine lock."""
+        docs = self._docs
+        sizes = {i: ld.resident_bytes() for i, ld in docs.items()}
+        total = sum(sizes.values())
+        n0 = self.stats["demoted"]
+        if docs and total > cap:
+            mru = (
+                max(docs.values(), key=lambda l: l.last_use)
+                if protect_mru
+                else None
+            )
+            for ld in sorted(docs.values(), key=lambda l: l.last_use):
+                if total <= cap:
+                    break
+                if ld is mru or ld.queued or ld.pending:
+                    continue
+                if not self._demotable(ld):
+                    continue
+                self._demote_locked(ld)
+                total -= sizes[ld.doc.id]
+        self.stats["live_bytes"] = total
+        self.stats["live_docs"] = len(docs)
+        return self.stats["demoted"] - n0
+
+    def _demotable(self, ld: _LiveDoc) -> bool:
+        """Re-adoption must be able to rebuild this exact state from
+        the feed sidecars (the shared _serveable_spec rule — the same
+        check adoption and the demoted snapshot closure run). Changes
+        injected straight into the engine with no backing feed
+        (synthetic peers, tests) pin the doc resident — demoting would
+        silently lose them. The verdict memoizes per serving clock
+        (either way), so over-budget ticks do not re-pay the sidecar
+        scans — the scan runs under the engine lock, the repo's one
+        emission lock. If a sidecar regresses OUT-OF-BAND after a
+        positive memo, re-adoption still re-checks serveability and
+        falls back to the host path, so a stale verdict degrades, not
+        corrupts."""
+        doc = ld.doc
+        with doc._lock:
+            if doc._lazy_loader is None:
+                return False
+        memo = ld.demotable_at
+        if memo is not None and memo[0] == ld.clock:
+            return memo[1]
+        verdict = self._back._serveable_spec(ld.clock) is not None
+        ld.demotable_at = (dict(ld.clock), verdict)
+        return verdict
+
+    def _demote_locked(self, ld: _LiveDoc) -> None:
+        """Hand an idle adopted doc back to the lazy path: the serving
+        clock/length sync to the doc (they already do, per admission),
+        the engine forgets its LiveColumns + decoded state, and the
+        doc's next live change re-adopts from the sidecars (cheap: the
+        vectorized decode). Reads keep working — a fresh lazy snapshot
+        closure replaces the engine's state for Ready/reopen. Caller
+        holds the engine lock."""
+        doc = ld.doc
+        log("live", f"demoting {doc.id[:6]} to lazy (LRU)")
+        snap = self._back._demoted_snapshot_fn(doc.id, dict(ld.clock))
+        doc.demote_from_live(dict(ld.clock), ld.history_len, snap)
+        self._docs.pop(doc.id, None)
+        self._demoted_ids.add(doc.id)
+        self.stats["demoted"] += 1
 
     @staticmethod
     def _ranges_ok(lv: LiveColumns) -> bool:
@@ -685,6 +1128,7 @@ class LiveApplyEngine:
     def _on_tick(self, marked: Dict) -> None:
         with self._lock:
             self._flush_ids(list(marked))
+            self._enforce_budget_locked()
 
     def _flush_ids(self, doc_ids: List[str]) -> None:
         """Apply every queued change of the named docs; emit one delta
@@ -707,6 +1151,7 @@ class LiveApplyEngine:
         t0 = now()
         batches = []
         for ld in dirty:
+            ld.last_use = self._bump_use()
             changes = ld.queued
             ld.queued = []
             stats["tick_changes"] += len(changes)
@@ -778,7 +1223,8 @@ class LiveApplyEngine:
         )
         for ld, lanes in zip(lds, lanes_by_doc):
             t1 = now()
-            new_state = _decode_state(ld.cols, lanes)
+            with _gc_paused():
+                new_state = _decode_state(ld.cols, lanes)
             t2 = now()
             diffs = _diff_states(ld.state, new_state)
             ld.state = new_state
@@ -795,30 +1241,31 @@ class LiveApplyEngine:
         view per doc. Device when the padded batch clears the min-cells
         bar, numpy twin otherwise (both bit-identical — the twin is the
         fuzz reference)."""
-        from ..ops.host_kernel import _host_doc_kernel
-
         D = len(lds)
         if D * bucket_n < _device_min_cells():
             self.stats["kernel_runs"] += 1
-            outs = []
-            for ld in lds:
-                lv = ld.cols
-                n = lv.n
-                A = max(1, len(lv.actors.items))
-                K = max(1, len(lv.keys.items))
-                c = lv.cols
-                outs.append(
-                    _host_doc_kernel(
-                        c["action"][:n], lv.slots(), c["ctr"][:n],
-                        np.zeros(n, np.int32), c["obj"][:n],
-                        c["key"][:n], c["ref"][:n], c["insert"][:n],
-                        c["value"][:n], lv.psrc[: lv.n_preds],
-                        lv.ptgt[: lv.n_preds],
-                        np.arange(A, dtype=np.int32), A, K,
-                    )
-                )
-            return outs
+            return [self._host_lanes(ld.cols) for ld in lds]
         return self._kernel_device(bucket_n, lds)
+
+    @staticmethod
+    def _host_lanes(lv: LiveColumns):
+        """One doc's numpy kernel lanes over its UNPADDED live columns
+        — shared by the tick path's small-group kernel and adoption
+        (which runs at exact n instead of the padded batch shape)."""
+        from ..ops.host_kernel import _host_doc_kernel
+
+        n = lv.n
+        A = max(1, len(lv.actors.items))
+        K = max(1, len(lv.keys.items))
+        c = lv.cols
+        return _host_doc_kernel(
+            c["action"][:n], lv.slots(), c["ctr"][:n],
+            np.zeros(n, np.int32), c["obj"][:n],
+            c["key"][:n], c["ref"][:n], c["insert"][:n],
+            c["value"][:n], lv.psrc[: lv.n_preds],
+            lv.ptgt[: lv.n_preds],
+            np.arange(A, dtype=np.int32), A, K,
+        )
 
     def _kernel_device(self, bucket_n: int, lds: List[_LiveDoc]):
         from ..ops.crdt_kernels import (
@@ -1116,18 +1563,6 @@ class LiveApplyEngine:
 
 # ---------------------------------------------------------------------------
 # lane adapters
-
-
-class _LaneView:
-    """Per-doc view over stacked HostOut lanes."""
-
-    __slots__ = ("visible", "elem_live", "rank", "inc_total")
-
-    def __init__(self, out, d: int) -> None:
-        self.visible = np.asarray(out.visible[d])
-        self.elem_live = np.asarray(out.elem_live[d])
-        self.rank = np.asarray(out.rank[d])
-        self.inc_total = np.asarray(out.inc_total[d])
 
 
 class _LaneDict:

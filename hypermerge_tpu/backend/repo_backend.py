@@ -1248,6 +1248,40 @@ class RepoBackend:
 
         return load
 
+    def _demoted_snapshot_fn(self, doc_id: str, clock: Dict[str, int]):
+        """Ready/reopen snapshot closure for a doc the live engine
+        DEMOTED back to lazy: decode the feed windows at the doc's
+        serving clock through the numpy kernel twin — no host OpSet,
+        no engine state. Falls back to a clamped OpSet replay when a
+        sidecar can no longer serve the window (e.g. the feed was
+        truncated out-of-band after demotion)."""
+
+        def snap():
+            from ..ops.columnar import pack_docs_columns
+            from ..ops.host_kernel import run_batch_host
+            from ..ops.materialize import DecodedBatch, decode_patch
+
+            spec = self._serveable_spec(clock)
+            if spec is not None:
+                batch = pack_docs_columns([spec] if spec else [[]])
+                dec = DecodedBatch(
+                    batch,
+                    run_batch_host(batch),
+                    host_clocks=[dict(clock)],
+                )
+                return decode_patch(dec, 0)
+            sub = OpSet()
+            sub.apply_changes(
+                [
+                    c
+                    for c in self._bulk_history_loader(doc_id)()
+                    if c.seq <= clock.get(c.actor, 0)
+                ]
+            )
+            return sub.snapshot_patch()
+
+        return snap
+
     def _writable_actor_for(self, doc_id: str) -> str:
         cursor = self.cursors.get(self.id, doc_id)
         for actor_id in cursor:
@@ -1289,6 +1323,47 @@ class RepoBackend:
         if self.network is not None:
             self.network.announce_feed(feed)
         return actor
+
+    def _peek_actor(self, actor_id: str) -> Optional[Actor]:
+        """An actor by id WITHOUT materializing storage for unknown
+        keys: unlike _get_or_create_actor this never registers or
+        announces an EMPTY feed — a refused live adoption (missing /
+        short / non-contiguous feed) must not pollute the store with
+        phantom actor feeds. Returns None when no feed exists; a feed
+        that DOES exist wraps through _get_or_create_actor (same
+        construction, same race semantics — open_if_present has
+        already registered it in the FeedStore, so no new storage is
+        created)."""
+        with self._lock:
+            actor = self.actors.get(actor_id)
+        if actor is not None:
+            return actor
+        if self.feeds.open_if_present(actor_id) is None:
+            return None
+        return self._get_or_create_actor(actor_id)
+
+    def _serveable_spec(self, clock: Dict[str, int]):
+        """[(FeedColumns, 0, end), ...] feed windows able to serve
+        `clock` from the columnar sidecars, or None when any actor
+        feed is absent, short, or non-contiguous. Non-creating
+        (_peek_actor). THE shared serveability rule: live adoption,
+        demotion eligibility, and the demoted snapshot closure all
+        call this, so they can never disagree about what the sidecars
+        can rebuild."""
+        spec = []
+        for actor_id, end in clock.items():
+            if end <= 0:
+                continue
+            actor = self._peek_actor(actor_id)
+            fc = actor.columns() if actor is not None else None
+            if (
+                fc is None
+                or not fc.seqs_contiguous()
+                or fc.n_changes < end
+            ):
+                return None
+            spec.append((fc, 0, end))
+        return spec
 
     def _get_or_create_actor(self, actor_id: str) -> Actor:
         with self._lock:

@@ -1311,12 +1311,17 @@ class LiveColumns:
         for b in batch.bigints:
             lv.bigints(b)
         ctr = batch.cols["ctr"][d, :n].tolist()
-        act = batch.cols["actor"][d, :n].tolist()
+        acts = batch.cols["actor"][d, :n]
         actors = batch.actors
-        lv.opids = [
-            OpId(int(c), actors[a]) for c, a in zip(ctr, act)
-        ]
-        lv.row_of = {opid: i for i, opid in enumerate(lv.opids)}
+        if n and int(acts.min()) == int(acts.max()):
+            # single-writer doc (the dominant bulk shape): one actor
+            # lookup for the whole column
+            writer = actors[int(acts[0])]
+            lv.opids = [OpId(c, writer) for c in ctr]
+        else:
+            names = [actors[a] for a in acts.tolist()]
+            lv.opids = list(map(OpId, ctr, names))
+        lv.row_of = dict(zip(lv.opids, range(n)))
         return lv
 
     # -- appends --------------------------------------------------------
@@ -1414,6 +1419,47 @@ class LiveColumns:
             int(self.cols["value"][row]),
             self,
         )
+
+    def decode_values(self, rows: np.ndarray) -> List[Any]:
+        """Decoded Python values for the given row indices — the batch
+        twin of `decode_row_value`, vectorized by value kind (one
+        nonzero + one tight fixup pass per kind present instead of a
+        per-row Python call). The live decode's value hot path."""
+        vk = self.cols["vkind"][rows]
+        out: List[Any] = self.cols["value"][rows].tolist()
+        if not out:
+            return out
+        # VK_INT rows are already right (tolist yields Python ints);
+        # patch the other kinds in place
+        m = vk == VK_NONE
+        if m.any():
+            for i in np.nonzero(m)[0].tolist():
+                out[i] = None
+        m = vk == VK_BOOL
+        if m.any():
+            for i in np.nonzero(m)[0].tolist():
+                out[i] = bool(out[i])
+        for code, table in (
+            (VK_FLOAT, self.floats.items),
+            (VK_STR, self.strings.items),
+            (VK_BIGINT, self.bigints.items),
+        ):
+            m = vk == code
+            if m.any():
+                for i in np.nonzero(m)[0].tolist():
+                    out[i] = table[out[i]]
+        return out
+
+    @property
+    def nbytes(self) -> int:
+        """Resident host bytes of this doc's live cache: the packed
+        numpy planes plus an estimate of the opids/row_of index
+        structures (~one OpId tuple + two dict/list slots per row).
+        What the live engine's byte-bounded LRU charges a hot doc."""
+        b = self.psrc.nbytes + self.ptgt.nbytes
+        for a in self.cols.values():
+            b += a.nbytes
+        return b + len(self.opids) * 144
 
 
 _COL_DEFAULTS = {"action": PAD, "obj": -1, "key": -1, "ref": -3}

@@ -1,0 +1,118 @@
+"""Profile the live engine's adoption path: stored docs -> first live
+edit, with the per-stage adoption timeline (pack / kernel / decode /
+reach busy vs wall) and the lock-held vs lock-free split, then a
+demote -> re-adopt cycle over the same docs.
+
+Usage: [PROF_DOCS=4] [PROF_OPS=8192] [JAX_PLATFORMS=cpu] \
+       python scripts/profile_live.py [--cprofile]
+"""
+
+import cProfile
+import os
+import pstats
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+n_docs = int(os.environ.get("PROF_DOCS", "4"))
+n_ops = int(os.environ.get("PROF_OPS", "8192"))
+
+from hypermerge_tpu.ops.corpus import make_corpus  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+
+ADOPT_KEYS = (
+    "t_adopt_pack", "t_adopt_kernel", "t_adopt_decode",
+    "t_adopt_reach", "t_adopt_lock_free", "t_adopt_lock_held",
+)
+
+tmp = tempfile.mkdtemp(prefix="hmlive")
+t0 = time.perf_counter()
+urls = make_corpus(tmp, n_docs, n_ops)
+print(
+    f"corpus: {n_docs} docs x {n_ops} ops in "
+    f"{time.perf_counter() - t0:.2f}s"
+)
+
+repo = Repo(path=tmp)
+handles = repo.open_many(urls)
+for h in handles:
+    assert h.value(timeout=120) is not None
+eng = repo.back.live
+assert eng is not None, "HM_LIVE=0: nothing to profile"
+
+
+def _snap():
+    return {k: eng.stats[k] for k in ADOPT_KEYS}
+
+
+def _delta(before, after):
+    return {k: after[k] - before[k] for k in ADOPT_KEYS}
+
+
+def _timeline(label, d, wall):
+    busy = sum(d[k] for k in ADOPT_KEYS[:4])
+    print(f"{label} (wall {wall * 1e3:.1f}ms):")
+    for k in ADOPT_KEYS[:4]:
+        frac = d[k] / wall if wall else 0.0
+        print(
+            f"  {k[8:]:<10} {d[k] * 1e3:7.1f}ms  "
+            f"{'#' * int(frac * 40):<40} {frac * 100:4.0f}%"
+        )
+    print(
+        f"  lock-free  {d['t_adopt_lock_free'] * 1e3:7.1f}ms   "
+        f"lock-HELD {d['t_adopt_lock_held'] * 1e3:7.2f}ms   "
+        f"(other docs tick through all but the held sliver)"
+    )
+    print(
+        f"  stage busy {busy * 1e3:7.1f}ms vs wall "
+        f"{wall * 1e3:.1f}ms"
+    )
+
+
+def adopt_all(label):
+    before = _snap()
+    t0 = time.perf_counter()
+    for u in urls:
+        repo.change(u, lambda d: d.__setitem__("hot", 1))
+    eng.flush_now()
+    wall = time.perf_counter() - t0
+    _timeline(label, _delta(before, _snap()), wall)
+    return wall
+
+
+def run():
+    adopt_all(f"adoption ({n_docs} docs x {n_ops} ops)")
+    demoted = eng.demote_idle(0)
+    print(f"demote_idle(0): {demoted} docs demoted")
+    before = _snap()
+    t0 = time.perf_counter()
+    for u in urls:
+        repo.change(u, lambda d: d.__setitem__("hot", 2))
+    eng.flush_now()
+    wall = time.perf_counter() - t0
+    _timeline("re-adoption after demote", _delta(before, _snap()), wall)
+    s = eng.stats
+    print(
+        f"engine: adopted={s['adopted']} demoted={s['demoted']} "
+        f"readopted={s['readopted']} refused={s['refused']} "
+        f"live_bytes={s['live_bytes']:,} live_docs={s['live_docs']}"
+    )
+
+
+if "--cprofile" in sys.argv:
+    prof = cProfile.Profile()
+    prof.enable()
+    run()
+    prof.disable()
+    stats = pstats.Stats(prof)
+    stats.sort_stats("cumulative").print_stats(30)
+else:
+    run()
+
+repo.close()
+import shutil  # noqa: E402
+
+shutil.rmtree(tmp, ignore_errors=True)

@@ -340,6 +340,216 @@ def _run_host(lv):
     )
 
 
+def test_adopt_refused_missing_actor_creates_no_feed(tmp_path, live_env):
+    """A refused adoption (the serving clock names an actor we hold no
+    feed for) must NOT materialize an empty actor feed on disk — the
+    old _get_or_create_actor lookup registered + announced a phantom
+    feed (feed_info row, feeds/ directory entry) as a side effect of
+    merely refusing."""
+    import os as _os
+
+    url, doc_id, _ = _seed_dir(str(tmp_path))
+    repo = Repo(path=str(tmp_path))
+    repo.back.load_documents_bulk([doc_id])
+    doc = repo.back.docs[doc_id]
+    assert doc.opset is None and doc._lazy_loader is not None
+    bogus = "zzbogusactorzzzzzzzzzzzzzzzzzzzz"
+    with doc._lock:
+        doc._lazy_clock[bogus] = 3  # feed we can never serve
+    # first live change: adoption must refuse (missing feed) and the
+    # host path must still apply the change correctly
+    repo.change(url, lambda d: d.__setitem__("after", 1))
+    assert repo.doc(url)["after"] == 1
+    assert repo.back.live.stats["refused"] == 1
+    assert doc.opset is not None  # host fallback took over
+    # no phantom feed materialized anywhere
+    assert bogus not in repo.back.actors
+    assert repo.back.feeds.get_feed(bogus) is None
+    feed_path = _os.path.join(
+        str(tmp_path), "feeds", bogus[:2], bogus
+    )
+    assert not _os.path.exists(feed_path)
+    repo.close()
+
+
+def test_adoption_reachability_lanes_twin():
+    """The adoption path's lane-driven reachability (winner-link forest
+    from map_winner/elem_winner) is bit-identical to both the state
+    walk and the full snapshot diff walk, on randomized multi-actor
+    docs (nested objects, deletes, counters, text)."""
+    from hypermerge_tpu.backend.live import (
+        _compute_reachable,
+        _decode_state,
+        _diff_states,
+        _DocState,
+        _reachable_from_lanes,
+    )
+    from hypermerge_tpu.ops.columnar import (
+        LiveColumns,
+        causal_sort,
+        pack_docs,
+    )
+
+    for seed in range(6):
+        r = random.Random(seed * 7919)
+        sites = [Site(f"r{i}000000000001") for i in range(3)]
+        for _ in range(30):
+            random_mutation(r.choice(sites), r)
+            if r.random() < 0.3:
+                sync(*sites)
+        sync(*sites)
+        changes = causal_sort(
+            [c for s in sites for c in s.opset.history]
+        )
+        batch = pack_docs([changes])
+        lv = LiveColumns.from_batch(batch, 0)
+        lanes = _run_host(lv)
+        st = _decode_state(lv, lanes)
+        from_lanes = _reachable_from_lanes(lv, lanes)
+        st_walk = _decode_state(lv, lanes)
+        _compute_reachable(st_walk)
+        st_diff = _decode_state(lv, lanes)
+        _diff_states(_DocState(), st_diff)  # sets reachable
+        assert from_lanes == st_walk.reachable == st_diff.reachable, (
+            seed,
+            sorted(map(str, from_lanes ^ st_diff.reachable)),
+        )
+        assert st.inc == st_diff.inc
+
+
+def test_other_docs_tick_during_adoption(tmp_path, live_env):
+    """The engine lock is NOT held across an adoption build: while one
+    doc's pack+kernel+decode is in flight (a replication thread), a
+    different hot doc's remote changes admit AND its tick emits.
+    Deterministic — the build blocks until the other doc's edit lands,
+    so a regression (build back under the engine lock) stalls the
+    admission/tick and fails the wait, instead of flaking on timing."""
+    import threading as _th
+
+    repo = Repo(path=str(tmp_path))
+    url_a = repo.create({"n": 0})
+    url_b = repo.create({"n": 0})
+    for k in range(8):
+        repo.change(url_a, lambda d, k=k: d.__setitem__("n", k))
+        repo.change(url_b, lambda d, k=k: d.__setitem__("n", k))
+    ids = [validate_doc_url(u) for u in (url_a, url_b)]
+    stored = {i: _local_changes(repo, ids[i]) for i in range(2)}
+    repo.close()
+
+    repo2 = Repo(path=str(tmp_path))
+    repo2.back.load_documents_bulk(ids)
+    eng = repo2.back.live
+    doc_a, doc_b = (repo2.back.docs[i] for i in ids)
+    peers = []
+    for i in range(2):
+        p = Site(f"stall{i:1d}000000001")
+        p.receive(stored[i])
+        peers.append(p)
+    # adopt A up front (one remote edit + tick)
+    ch_a0, _ = peers[0].change(lambda d: d.__setitem__("r", 0))
+    doc_a.apply_remote_changes([ch_a0])
+    eng.flush_now()
+    wait_until(lambda: repo2.doc(url_a).get("r") == 0)
+
+    started = _th.Event()
+    observed = _th.Event()
+    orig = eng._adopt_build
+
+    def gated_build(doc):
+        out = orig(doc)
+        started.set()
+        assert observed.wait(20), "ticks stalled during adoption build"
+        return out
+
+    eng._adopt_build = gated_build
+    ch_b, _ = peers[1].change(lambda d: d.__setitem__("r", 1))
+    t = _th.Thread(
+        target=lambda: doc_b.apply_remote_changes([ch_b])
+    )  # a replication thread adopting doc B
+    t.start()
+    assert started.wait(20)
+    # B's adoption build is mid-flight: A's remote change must still
+    # admit (serving clock advances) and its tick must emit
+    ch_a1, _ = peers[0].change(lambda d: d.__setitem__("during", 3))
+    doc_a.apply_remote_changes([ch_a1])
+    wait_until(lambda: repo2.doc(url_a).get("during") == 3)
+    observed.set()
+    t.join(20)
+    assert not t.is_alive()
+    eng.flush_now()
+    wait_until(lambda: repo2.doc(url_b).get("r") == 1)
+    assert eng.stats["adopted"] == 2
+    assert eng.stats["refused"] == 0
+    repo2.close()
+
+
+def test_emission_reentry_never_waits_on_adoption_gate(
+    tmp_path, live_env
+):
+    """A thread that already holds the engine (emission) lock — a
+    frontend callback re-entering the repo mid-emission — must NOT
+    wait on another thread's in-flight adoption gate: the builder
+    needs that lock to install, so waiting with it held would wedge
+    every emission. The guard answers host-path (None/False)
+    immediately instead."""
+    import threading as _th
+
+    repo = Repo(path=str(tmp_path))
+    url = repo.create({"n": 0})
+    for k in range(6):
+        repo.change(url, lambda d, k=k: d.__setitem__("n", k))
+    doc_id = validate_doc_url(url)
+    stored = _local_changes(repo, doc_id)
+    repo.close()
+
+    repo2 = Repo(path=str(tmp_path))
+    repo2.back.load_documents_bulk([doc_id])
+    eng = repo2.back.live
+    doc = repo2.back.docs[doc_id]
+    peer = Site("reent00000000001")
+    peer.receive(stored)
+    ch, _ = peer.change(lambda d: d.__setitem__("r", 1))
+
+    started = _th.Event()
+    release = _th.Event()
+    orig = eng._adopt_build
+
+    def gated_build(d):
+        out = orig(d)
+        started.set()
+        assert release.wait(20)
+        return out
+
+    eng._adopt_build = gated_build
+    builder = _th.Thread(
+        target=lambda: doc.apply_remote_changes([ch])
+    )
+    builder.start()
+    assert started.wait(20)
+    # simulate the re-entry: this thread holds the emission lock and
+    # submits for the doc whose adoption is mid-build elsewhere
+    results = []
+
+    def under_lock():
+        with eng._lock:
+            results.append(eng.submit_remote(doc, [ch]))
+
+    probe = _th.Thread(target=under_lock)
+    probe.start()
+    probe.join(5)
+    deadlocked = probe.is_alive()
+    release.set()  # let the builder finish either way
+    builder.join(20)
+    probe.join(5)
+    assert not deadlocked, (
+        "emission-lock holder blocked on the adoption gate"
+    )
+    assert results == [False]  # host path, answered immediately
+    eng.flush_now()
+    wait_until(lambda: repo2.doc(url).get("r") == 1)
+    repo2.close()
+
+
 def test_live_reopen_serves_fresh_snapshot(tmp_path, live_env):
     """A handle reopened on a live-adopted doc gets the CURRENT state
     (the engine's snapshot twin), not the stale bulk-load decode."""
