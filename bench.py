@@ -171,6 +171,102 @@ def _config2_run(ra, rb, sa, sb, n_docs, n_edits):
     return dt, total_edits / dt, _live_stats(ra, rb)
 
 
+def _config_churn(n_docs=6, n_edits=40):
+    """BASELINE round-10 robustness config: burst edits on shared docs
+    over TCP while a seeded FaultPlan (net/faults.py) kills the link
+    mid-burst — twice — and the supervised redial (net/resilience.py)
+    restores replication with NO manual reconnect. Reports convergence
+    wall clock plus the churn counters: supervisor reconnects,
+    replication resyncs + t_resync_ms, injected frame drops."""
+    import time as _t
+
+    from hypermerge_tpu.net.faults import FaultPlan, FaultSwarm
+    from hypermerge_tpu.net.tcp import TcpSwarm
+    from hypermerge_tpu.repo import Repo
+
+    env_save = {
+        k: os.environ.get(k)
+        for k in ("HM_REDIAL_BASE_MS", "HM_REDIAL_MAX_S")
+    }
+    # everything after the env writes sits inside the try: a
+    # constructor failure must not leak the redial overrides (or live
+    # repos/sockets) into the remaining fail-soft bench configs
+    ra = rb = sa = fb = None
+    try:
+        os.environ["HM_REDIAL_BASE_MS"] = "50"
+        os.environ["HM_REDIAL_MAX_S"] = "1"
+        plan = FaultPlan(
+            seed=10,
+            events=[(1, "kill"), (2, "heal"), (3, "kill"), (4, "heal")],
+        )
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sbi = TcpSwarm(), TcpSwarm()
+        fb = FaultSwarm(sbi, plan)
+        ra.set_swarm(sa)
+        rb.set_swarm(fb)
+        fb.connect(sa.address)
+        urls = [ra.create({"edits": []}) for _ in range(n_docs)]
+        handles = [rb.open(u) for u in urls]
+        for h in handles:
+            assert h.value(timeout=30) is not None
+
+        t0 = _t.perf_counter()
+        quarter = max(1, n_edits // 4)
+        for i in range(n_edits):
+            for u in urls:
+                ra.change(u, lambda d, i=i: d["edits"].append(i))
+            if i % 5 == 0:
+                for h in handles:
+                    h.change(lambda d, i=i: d["edits"].append(1000 + i))
+            if i % quarter == quarter - 1:
+                fb.tick()  # kill/heal schedule fires mid-burst
+        while plan.tick < 4:
+            fb.tick()  # link healed for the convergence wait
+        want = n_edits + (n_edits + 4) // 5
+        deadline = _t.perf_counter() + 120
+        while _t.perf_counter() < deadline:
+            vals = [h.value() for h in handles]
+            if all(
+                v is not None and len(v.get("edits", [])) >= want
+                for v in vals
+            ) and all(
+                len(ra.doc(u).get("edits", [])) >= want for u in urls
+            ):
+                break
+            _t.sleep(0.01)
+        else:
+            raise AssertionError("config_churn did not converge")
+        dt = _t.perf_counter() - t0
+        ra_stats = ra.back.network.replication.stats
+        rb_stats = rb.back.network.replication.stats
+        counters = {
+            "reconnects": sbi.supervisor.stats["reconnects"],
+            "resyncs": round(
+                ra_stats["resyncs"] + rb_stats["resyncs"]
+            ),
+            "t_resync_ms": round(
+                ra_stats["t_resync_ms"] + rb_stats["t_resync_ms"], 1
+            ),
+            "frames_dropped_injected": fb.stats[
+                "frames_dropped_injected"
+            ],
+        }
+        assert counters["reconnects"] >= 1, counters
+        return dt, n_docs * want / dt, counters
+    finally:
+        for r in (ra, rb):
+            if r is not None:
+                r.close()
+        for s in (fb, sa):
+            if s is not None:
+                s.destroy()
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _config6_live_burst(n_ops=8192, n_burst=256):
     """Live-apply on ONE hot text-trace doc (the single-doc shape of
     config6, on the LIVE path): a stored n_ops-op doc opens lazily,
@@ -667,6 +763,14 @@ def main() -> None:
         )
         if cfg2[2]:
             print(f"# config2 live-apply: {cfg2[2]}", file=sys.stderr)
+    cfgch = _soft("config_churn", _config_churn)
+    if cfgch is not None:
+        print(
+            f"# config_churn convergence under kill/heal: "
+            f"{cfgch[0]:.2f}s ({cfgch[1]:,.0f} edits/s; "
+            f"churn {cfgch[2]})",
+            file=sys.stderr,
+        )
     cfg6l = _soft("config6_live", _config6_live_burst)
     if cfg6l is not None:
         st6 = cfg6l[2]
@@ -755,6 +859,15 @@ def main() -> None:
                     ),
                     "config2_live": (
                         cfg2[2] if cfg2 is not None else None
+                    ),
+                    "config_churn_s": (
+                        round(cfgch[0], 2) if cfgch is not None else None
+                    ),
+                    "config_churn_edits_per_s": (
+                        round(cfgch[1]) if cfgch is not None else None
+                    ),
+                    "config_churn": (
+                        cfgch[2] if cfgch is not None else None
                     ),
                     "config6_live_first_edit_ms": (
                         round(cfg6l[0], 1) if cfg6l is not None else None

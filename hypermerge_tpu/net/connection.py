@@ -15,6 +15,7 @@ native/ with a pure fallback) — the reference's noise wrapping
 
 from __future__ import annotations
 
+import threading
 import uuid
 from collections import deque
 from typing import Any, Callable, Dict, Optional
@@ -46,6 +47,7 @@ class PeerConnection:
         self._channels: Dict[str, Channel] = {}
         self.is_open = True
         self._close_listeners = []
+        self._close_lock = threading.Lock()
         self.network_bus = self.open_channel(NETWORK_BUS)
         duplex.on_message(self._on_raw)
         duplex.on_close(self._on_transport_close)
@@ -85,18 +87,37 @@ class PeerConnection:
         self.open_channel(name).receive_q.push(msg)
 
     def on_close(self, cb: Callable[[], None]) -> None:
-        self._close_listeners.append(cb)
+        """A listener registered after the connection already closed
+        fires immediately: under churn the transport can die between a
+        caller's `is_open` check and its registration, and a silently
+        dropped listener leaves the peer wired to a dead connection
+        (NetworkPeer would never fire on_inactive -> replication never
+        resets -> the redialed connection renegotiates against stale
+        associations). The lock makes check-then-append atomic against
+        the close path's listener snapshot — without it, a listener
+        appended between the snapshot and is_open flipping is silently
+        lost, the exact failure this method exists to prevent."""
+        with self._close_lock:
+            if self.is_open:
+                self._close_listeners.append(cb)
+                return
+        cb()
 
     def _on_transport_close(self) -> None:
-        if not self.is_open:
-            return
-        self.is_open = False
-        for cb in list(self._close_listeners):
+        with self._close_lock:
+            if not self.is_open:
+                return
+            self.is_open = False
+            listeners = list(self._close_listeners)
+        for cb in listeners:
             cb()
 
     def close(self) -> None:
-        if self.is_open:
+        with self._close_lock:
+            if not self.is_open:
+                return
             self.is_open = False
-            self._duplex.close()
-            for cb in list(self._close_listeners):
-                cb()
+            listeners = list(self._close_listeners)
+        self._duplex.close()
+        for cb in listeners:
+            cb()

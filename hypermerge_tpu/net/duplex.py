@@ -21,7 +21,7 @@ class Duplex:
 
     def __init__(self) -> None:
         self._on_message: Optional[Callable[[Any], None]] = None
-        self._on_close: Optional[Callable[[], None]] = None
+        self._close_cbs: list = []
         self._inbox: deque = deque()
         self._peer: Optional["Duplex"] = None
         self._scheduler: Optional["_Trampoline"] = None
@@ -32,7 +32,13 @@ class Duplex:
         self._drain_inbox()
 
     def on_close(self, cb: Callable[[], None]) -> None:
-        self._on_close = cb
+        """Multi-listener, same contract as TcpDuplex.on_close: the
+        connection stack AND wrappers (fault injection, supervisors)
+        may both watch; registering after close fires immediately."""
+        if self.closed:
+            cb()
+        else:
+            self._close_cbs.append(cb)
 
     def send(self, msg: Any) -> None:
         if self.closed or self._peer is None:
@@ -56,8 +62,8 @@ class Duplex:
         if self.closed:
             return
         self.closed = True
-        if self._on_close is not None:
-            self._on_close()
+        for cb in list(self._close_cbs):
+            cb()
         peer = self._peer
         if peer is not None and not peer.closed:
             self._scheduler.defer(peer.close)

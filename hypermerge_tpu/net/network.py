@@ -10,6 +10,7 @@ sync rides "Replication" (net/replication.py).
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Dict, Optional, Set
 
@@ -48,6 +49,16 @@ class Network:
     ) -> None:
         if self.swarm is not None:
             raise RuntimeError("swarm already set")
+        fault_spec = os.environ.get("HM_FAULT")
+        if fault_spec:
+            # fault-injection soak mode: every connection of every
+            # swarm rides a seeded FaultDuplex (net/faults.py), ticks
+            # advanced on a wall-clock timer
+            from .faults import FaultSwarm, parse_fault_spec
+
+            swarm = FaultSwarm(swarm, parse_fault_spec(fault_spec))
+            swarm.start_ticker()
+            log("network", f"HM_FAULT active: {fault_spec}")
         self.swarm = swarm
         # the repo's swarm posture (reference Network.ts:22 — every
         # join uses it; server-ish repos announce, clients look up)
@@ -97,6 +108,9 @@ class Network:
             if msg.get("type") != "Info":
                 return
             state["done"] = True
+            timer = state.pop("timer", None)
+            if timer is not None:  # reaper thread retires on success
+                timer.cancel()
             # hand the bus off to the NetworkPeer (single-subscriber
             # queue); anything arriving in between buffers
             conn.network_bus.receive_q.unsubscribe()
@@ -124,6 +138,29 @@ class Network:
         conn.network_bus.subscribe(on_info)
         conn.network_bus.send(msgs.info_msg(self.self_id))
         conn.on_close(self._count_close)
+        # half-wired reaper: a connection whose Info exchange never
+        # completes (the peer's frame lost to a faulty middlebox or
+        # injected fault) must not idle forever behind healthy
+        # keepalives — close it so the supervised redial renegotiates
+        # from scratch
+        timeout = float(os.environ.get("HM_INFO_TIMEOUT_S", "20"))
+        if timeout > 0:
+            def reap() -> None:
+                if not state["done"] and conn.is_open:
+                    log(
+                        "network",
+                        "Info exchange timed out: closing "
+                        "half-wired connection",
+                    )
+                    conn.close()
+
+            timer = threading.Timer(timeout, reap)
+            timer.daemon = True
+            state["timer"] = timer
+            timer.start()
+            conn.on_close(timer.cancel)
+            if state["done"]:  # Info landed before the timer stored
+                timer.cancel()
 
     def _count_close(self) -> None:
         self.closed_connection_count += 1
@@ -147,7 +184,23 @@ class Network:
         """Fires for EVERY connection that becomes active (including
         replacements after churn): wire channels on the new connection."""
         log("network", f"peer active {peer.id[:6]}")
-        ch = peer.connection.open_channel(MSGS_CHANNEL)
+        conn = peer.connection
+        if conn is None or not conn.is_open:
+            # lost the race to a concurrent close: raising here would
+            # kill the transport reader that delivered the activation;
+            # the close path fires on_inactive and the next connection
+            # re-wires cleanly
+            return
+        # wire each CONNECTION exactly once: a stale activation (its
+        # own connection already replaced) reads the newer connection
+        # here, and without the latch the real activation's duplicate
+        # channel subscribe would raise mid-wiring, leaving
+        # replication unnegotiated on the surviving connection
+        with self._lock:
+            if getattr(conn, "_hm_wired", False):
+                return
+            conn._hm_wired = True
+        ch = conn.open_channel(MSGS_CHANNEL)
         ch.subscribe(lambda msg: self._on_peer_msg(peer, msg))
         self.replication.on_peer(peer)
 
@@ -202,14 +255,14 @@ class Network:
 
     def send_cursor_to(self, peer: NetworkPeer, doc_id: str,
                        cursor: clockmod.Clock, clock: clockmod.Clock) -> None:
-        if peer.is_connected:
-            peer.connection.open_channel(MSGS_CHANNEL).send(
-                msgs.cursor_message(
-                    doc_id,
-                    clockmod.clock_to_strs(cursor),
-                    clockmod.clock_to_strs(clock),
-                )
-            )
+        peer.try_send(
+            MSGS_CHANNEL,
+            msgs.cursor_message(
+                doc_id,
+                clockmod.clock_to_strs(cursor),
+                clockmod.clock_to_strs(clock),
+            ),
+        )
 
     def gossip_cursor(
         self, doc_id: str, cursor: clockmod.Clock, clock: clockmod.Clock
@@ -219,10 +272,9 @@ class Network:
 
     def broadcast_doc_message(self, doc_id: str, contents: Any) -> None:
         for peer in self._peers_for_doc(doc_id):
-            if peer.is_connected:
-                peer.connection.open_channel(MSGS_CHANNEL).send(
-                    msgs.document_message(doc_id, contents)
-                )
+            peer.try_send(
+                MSGS_CHANNEL, msgs.document_message(doc_id, contents)
+            )
 
     # ------------------------------------------------------------------
 

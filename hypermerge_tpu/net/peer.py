@@ -15,7 +15,8 @@ connection is lost without a replacement, so replication state can reset.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+import threading
+from typing import Any, Callable, List, Optional
 
 from .. import msgs
 from ..utils.debug import log
@@ -36,6 +37,9 @@ class NetworkPeer:
         self._on_inactive = on_inactive
         self.connection: Optional[PeerConnection] = None
         self._pending: List[PeerConnection] = []
+        # guards _pending: mutated from accept/supervisor threads
+        # (add_connection) AND reader threads (close-driven prune)
+        self._plock = threading.Lock()
 
     @property
     def we_have_authority(self) -> bool:
@@ -55,11 +59,41 @@ class NetworkPeer:
             self._confirm(conn)
             conn.network_bus.send(msgs.confirm_connection_msg(conn.id))
         else:
-            self._pending.append(conn)
-            if self.connection is None and len(self._pending) == 1:
+            # churn hygiene: dead connections must LEAVE pending, or a
+            # reconnect after a lost ConfirmConnection finds
+            # len(pending) > 1 forever and never optimistically wires
+            # the only live connection
+            with self._plock:
+                self._pending = [c for c in self._pending if c.is_open]
+                self._pending.append(conn)
+                use_now = (
+                    self.connection is None and len(self._pending) == 1
+                )
+            conn.on_close(lambda: self._prune_pending(conn))
+            if use_now:
                 # optimistically use the first connection until (unless)
                 # the authority confirms a different one
                 self._use(conn)
+
+    def _prune_pending(self, conn: PeerConnection) -> None:
+        with self._plock:
+            try:
+                self._pending.remove(conn)
+            except ValueError:
+                pass
+
+    def try_send(self, channel: str, msg: Any) -> bool:
+        """Snapshot-send on the active connection. THE send idiom for
+        churn safety: `peer.connection` can flip to None between an
+        `is_connected` check and the send, so callers must not
+        check-then-use it themselves. False when no live connection
+        (the dropped frame is recovered by the replacement
+        connection's resync)."""
+        conn = self.connection
+        if conn is not None and conn.is_open:
+            conn.open_channel(channel).send(msg)
+            return True
+        return False
 
     def _on_bus(self, conn: PeerConnection, msg) -> None:
         if isinstance(msg, dict) and msg.get("type") == "ConfirmConnection":
@@ -69,10 +103,12 @@ class NetworkPeer:
             self._confirm(conn)
 
     def _confirm(self, conn: PeerConnection) -> None:
-        for other in list(self._pending):
-            if other is not conn and other.is_open:
+        with self._plock:
+            others = [c for c in self._pending if c is not conn]
+            self._pending = []
+        for other in others:
+            if other.is_open:
                 other.close()
-        self._pending = []
         self._use(conn)
 
     def _use(self, conn: PeerConnection) -> None:
@@ -96,7 +132,9 @@ class NetworkPeer:
     def close(self) -> None:
         if self.connection is not None:
             self.connection.close()
-        for c in self._pending:
+        with self._plock:
+            pending = list(self._pending)
+            self._pending = []
+        for c in pending:
             if c.is_open:
                 c.close()
-        self._pending = []

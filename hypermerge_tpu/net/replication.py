@@ -57,6 +57,7 @@ import base64
 import hmac
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set
 
 from ..storage.feed import Feed, FeedStore
@@ -113,6 +114,12 @@ class ReplicationManager:
         # SparseBlocks push (even with valid proofs) must not grow
         # memory on a peer that never requested it
         self._sparse_wanted: Dict[str, Set[int]] = {}
+        # churn accounting: a peer re-activating after a close is a
+        # RESYNC (the supervised redial restored it); t_resync_ms sums
+        # redial -> first post-reconnect replication data frame
+        self.stats: Dict[str, float] = {"resyncs": 0, "t_resync_ms": 0.0}
+        self._seen_closed: Set[str] = set()
+        self._resync_t0: Dict[str, float] = {}
         # live-tail coalescing: public_key -> earliest unflushed block,
         # adaptive window (batches grow under sustained load instead of
         # frame count), drained on close
@@ -137,9 +144,15 @@ class ReplicationManager:
             return c
 
     def on_peer(self, peer: NetworkPeer) -> None:
+        conn = peer.connection
+        if conn is None:  # torn down while the activation was in flight
+            return
         with self._lock:
             self._peers.add(peer)
-        ch = peer.connection.open_channel(CHANNEL)
+            if peer.id in self._seen_closed:
+                self.stats["resyncs"] += 1
+                self._resync_t0[peer.id] = time.monotonic()
+        ch = conn.open_channel(CHANNEL)
         ch.subscribe(lambda msg: self._on_message(peer, msg))
         ch.send({
             "type": "DiscoveryIds",
@@ -152,6 +165,8 @@ class ReplicationManager:
     def on_peer_closed(self, peer: NetworkPeer) -> None:
         with self._lock:
             self._peers.discard(peer)
+            self._seen_closed.add(peer.id)
+            self._resync_t0.pop(peer.id, None)
             for did in self._replicating.keys_with(peer):
                 self._replicating.remove(did, peer)
             for did in self._verified.keys_with(peer):
@@ -166,14 +181,13 @@ class ReplicationManager:
         with self._lock:
             peers = list(self._peers)
         for peer in peers:
-            if peer.is_connected:
-                peer.connection.open_channel(CHANNEL).send({
-                    "type": "DiscoveryIds",
-                    "ids": [feed.discovery_id],
-                    "challenge": base64.b64encode(
-                        self._challenge_for(peer)
-                    ).decode("ascii"),
-                })
+            self._send(peer, {
+                "type": "DiscoveryIds",
+                "ids": [feed.discovery_id],
+                "challenge": base64.b64encode(
+                    self._challenge_for(peer)
+                ).decode("ascii"),
+            })
 
     def peers_with_feed(self, discovery_id: str) -> List[NetworkPeer]:
         with self._lock:
@@ -189,6 +203,21 @@ class ReplicationManager:
             return
         try:
             t = msg.get("type")
+            if t != "DiscoveryIds" and self._resync_t0:
+                # the reconnect's opener is DiscoveryIds; the first
+                # DATA-path frame after it closes the resync window.
+                # The unlocked emptiness pre-check keeps the steady-
+                # state data path lock-free (the dict is almost always
+                # empty); a window nothing ever closed (no shared
+                # feeds, idle link) must not charge the whole idle gap
+                # to a late unrelated frame: past 60s the resync is
+                # moot
+                with self._lock:
+                    t0 = self._resync_t0.pop(peer.id, None)
+                if t0 is not None:
+                    elapsed = time.monotonic() - t0
+                    if elapsed < 60:
+                        self.stats["t_resync_ms"] += elapsed * 1e3
             if t == "DiscoveryIds":
                 if "challenge" in msg:
                     with self._lock:
@@ -705,5 +734,4 @@ class ReplicationManager:
         self._flusher.close()
 
     def _send(self, peer: NetworkPeer, msg: Dict) -> None:
-        if peer.is_connected:
-            peer.connection.open_channel(CHANNEL).send(msg)
+        peer.try_send(CHANNEL, msg)

@@ -33,17 +33,27 @@ DEFAULT_JOIN = JoinOptions()
 
 
 class ConnectionDetails:
+    """Per-connection policy record. `reconnect(False)` and `ban()` are
+    CONSULTED now, not merely recorded: the redial supervisor
+    (net/resilience.py) stops a session whose details carry either, and
+    a transport may attach `_on_ban` to learn of bans as they happen
+    (net/tcp.py records the peer's identity/address and refuses it at
+    both dial and accept time)."""
+
     def __init__(self, client: bool, peer_info=None) -> None:
         self.client = client
         self.peer = peer_info
         self._reconnect_allowed = True
         self.banned = False
+        self._on_ban: Optional[Callable[[], None]] = None
 
     def reconnect(self, allowed: bool) -> None:
         self._reconnect_allowed = allowed
 
     def ban(self) -> None:
         self.banned = True
+        if self._on_ban is not None:
+            self._on_ban()
 
 
 class Swarm:

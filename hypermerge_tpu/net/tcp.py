@@ -25,10 +25,20 @@ from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..utils.debug import log
+from .resilience import SessionSupervisor, dial_timeout_s
 from .swarm import ConnectionDetails, Swarm
 
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
+
+# keepalive frames: duplex-level, never delivered to subscribers. A
+# pre-keepalive peer drops them as malformed channel frames
+# (net/connection.py _on_raw) and never pongs — so a fully IDLE
+# connection to such a peer is eventually shed and redialed (it is
+# indistinguishable from half-open by design; any real frame from the
+# peer counts as liveness). Every in-tree transport pongs.
+_PING = "__hm_ping"
+_PONG = "__hm_pong"
 
 
 def _outbox_cap() -> int:
@@ -38,6 +48,18 @@ def _outbox_cap() -> int:
     return int(
         float(os.environ.get("HM_TCP_OUTBOX_MB", "64")) * (1 << 20)
     )
+
+
+def _ping_s() -> float:
+    """Keepalive period; 0 disables. A half-open socket (peer machine
+    gone, NAT timeout, stalled reader) is detected within
+    2 * HM_NET_PING_S * HM_NET_PING_MISSES seconds instead of at the
+    64MB outbox bound."""
+    return float(os.environ.get("HM_NET_PING_S", "15"))
+
+
+def _ping_misses() -> int:
+    return int(os.environ.get("HM_NET_PING_MISSES", "3"))
 
 
 class TcpDuplex:
@@ -75,9 +97,12 @@ class TcpDuplex:
         self._writer_dead = False  # writer hit a send error: no drain
         self._rx_eof = False  # peer closed/died: draining is pointless
         self._inbox: "Queue" = Queue("tcp:inbox")
-        self._on_close: Optional[Callable[[], None]] = None
+        self._close_cbs: List[Callable[[], None]] = []
         self._lock = threading.RLock()
         self.closed = False
+        # keepalive: any complete inbound frame is liveness
+        self._last_rx = time.monotonic()
+        self._ka_stop = threading.Event()
         self._session = None
         self._identity = identity
         if os.environ.get("HM_TCP_PLAINTEXT") != "1":
@@ -96,6 +121,12 @@ class TcpDuplex:
             target=self._write_loop, daemon=True
         )
         self._writer.start()
+        ping = _ping_s()
+        if ping > 0:
+            threading.Thread(
+                target=self._keepalive_loop, args=(ping, _ping_misses()),
+                daemon=True,
+            ).start()
 
     @property
     def channel_binding(self) -> Optional[bytes]:
@@ -173,14 +204,56 @@ class TcpDuplex:
         self._inbox.subscribe(cb)
 
     def on_close(self, cb: Callable[[], None]) -> None:
+        """Register a close listener. Multiple listeners are supported
+        (the connection stack AND the redial supervisor both watch);
+        a listener registered after close fires immediately."""
         fire_now = False
         with self._lock:
             if self.closed:
                 fire_now = True  # closed before anyone registered
             else:
-                self._on_close = cb
+                self._close_cbs.append(cb)
         if fire_now:
             cb()
+
+    def _keepalive_loop(self, period: float, miss_budget: int) -> None:
+        """Ping when the inbound side goes quiet; shed after the miss
+        budget. A half-open connection (peer machine gone, NAT timeout,
+        reader stalled with the socket open) looks healthy to the
+        writer until the outbox cap — this closes it in seconds: no
+        inbound frame for `period` sends a ping, `miss_budget`
+        consecutive quiet periods close the connection (and the redial
+        supervisor, if any, dials a fresh one)."""
+        misses = 0
+        last_probe = float("-inf")
+        while not self._ka_stop.wait(period):
+            if self.closed:
+                return
+            now = time.monotonic()
+            # a miss is "nothing arrived since my last probe" — NOT
+            # "idle at check time": a pong that lands just after a
+            # check must reset the budget even though the link is idle
+            if self._last_rx >= last_probe:
+                misses = 0
+            else:
+                misses += 1
+                # shed ON the Nth unanswered probe (>=, not >): with
+                # probes at period P the shed lands by (M+1)*P, inside
+                # the documented 2*P*M bound for every M >= 1
+                if misses >= miss_budget:
+                    log(
+                        "net:tcp",
+                        f"keepalive: {misses} unanswered probes "
+                        f"({period}s apart): half-open, shedding",
+                    )
+                    # a peer that answers no pings is by definition
+                    # not draining: skip close()'s bounded drain wait
+                    self._shed = True
+                    self.close()
+                    return
+            if now - self._last_rx >= period:
+                self.send({_PING: misses})
+                last_probe = now
 
     def send(self, msg: Any) -> None:
         """Queue a frame for the writer thread. Never blocks on the
@@ -277,6 +350,7 @@ class TcpDuplex:
             payload = self._read_exact(size)
             if payload is None:
                 break
+            self._last_rx = time.monotonic()  # any frame is liveness
             if self._session is not None:
                 payload = self._session.decrypt(payload)
                 if payload is None:
@@ -288,6 +362,13 @@ class TcpDuplex:
                 msg = json.loads(payload.decode("utf-8"))
             except ValueError:
                 continue  # corrupt frame: skip
+            if isinstance(msg, dict):
+                # keepalive frames stop here, never reach subscribers
+                if _PING in msg:
+                    self.send({_PONG: msg[_PING]})
+                    continue
+                if _PONG in msg:
+                    continue
             try:
                 self._inbox.push(msg)
             except Exception as e:  # subscriber bug must not kill reader
@@ -325,6 +406,8 @@ class TcpDuplex:
                         self._out_cv.wait(min(deadline, 0.2))
                         deadline -= time.monotonic() - t0
             self.closed = True
+            listeners = list(self._close_cbs)
+        self._ka_stop.set()
         with self._out_cv:
             self._out_cv.notify_all()  # writer exits
         try:
@@ -332,12 +415,20 @@ class TcpDuplex:
         except OSError:
             pass
         self._sock.close()
-        if self._on_close is not None:
-            self._on_close()
+        for cb in listeners:
+            cb()
 
 
 class TcpSwarm(Swarm):
-    """Accepts inbound connections; dials peers via `connect(addr)`."""
+    """Accepts inbound connections; dials peers via `connect(addr)`.
+
+    Outbound addresses are owned by a `SessionSupervisor`
+    (net/resilience.py): `connect` registers the address and returns
+    immediately; the dial + handshake run off-thread, a failed dial
+    backs off and retries instead of raising, and a dropped connection
+    redials until its ConnectionDetails recorded `reconnect(False)` or
+    `ban()`. Banned peer identities are also refused at ACCEPT time —
+    a banned peer's inbound redial used to be accepted unconditionally."""
 
     def __init__(
         self,
@@ -353,8 +444,20 @@ class TcpSwarm(Swarm):
         self.join_options: dict = {}
         self._cb: Optional[Callable] = None
         self._duplexes: List[TcpDuplex] = []
+        self._dlock = threading.Lock()
         self._destroyed = False
         self._identity: Optional[bytes] = identity
+        self._banned_ids: set = set()  # proven peer identities
+        self._banned_addrs: set = set()  # outbound dial addresses
+        self._banned_hosts: set = set()  # anonymous-peer fallback
+        self.supervisor = SessionSupervisor(
+            dial=self._dial,
+            deliver=self._deliver_outbound,
+            banned=lambda addr: (
+                addr in self._banned_addrs
+                or addr[0] in self._banned_hosts
+            ),
+        )
         self._accepter = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -382,7 +485,58 @@ class TcpSwarm(Swarm):
                 target=self._handle_inbound, args=(sock,), daemon=True
             ).start()
 
+    def _track(self, duplex: TcpDuplex) -> None:
+        """Track a live duplex; closed duplexes LEAVE the list (a
+        long-lived swarm under churn must not grow without bound). A
+        duplex tracked after destroy() began — an inbound redial can
+        complete its handshake between destroy's flag and its duplex
+        snapshot — is closed here instead of living as a zombie on a
+        destroyed swarm."""
+        with self._dlock:
+            self._duplexes.append(duplex)
+            dead = self._destroyed
+        duplex.on_close(lambda: self._untrack(duplex))
+        if dead:
+            duplex.close()
+
+    def _untrack(self, duplex: TcpDuplex) -> None:
+        with self._dlock:
+            try:
+                self._duplexes.remove(duplex)
+            except ValueError:
+                pass
+
+    def _record_ban(self, duplex: TcpDuplex, address=None) -> None:
+        """ConnectionDetails.ban() fired: sever the live connection NOW
+        and refuse this peer from then on — its proven identity at
+        accept AND dial time; on anonymous transports (no identity
+        auth) the peer HOST is the only stable key, so the whole host
+        is refused (blunt by necessity — run identity auth for
+        per-peer precision). Outbound dial addresses are banned too."""
+        ident = duplex.peer_identity
+        if ident is not None:
+            self._banned_ids.add(ident)
+        else:
+            try:
+                self._banned_hosts.add(duplex._sock.getpeername()[0])
+            except OSError:
+                pass  # already disconnected: nothing stable to record
+        if address is not None:
+            self._banned_addrs.add(tuple(address))
+        log("net:tcp", f"banned peer id={str(ident)[:6]} addr={address}")
+        duplex.close()  # a ban is effective immediately, not at the
+        # next natural drop (keepalive would keep a healthy banned
+        # link alive indefinitely)
+
     def _handle_inbound(self, sock: socket.socket) -> None:
+        try:
+            peer_host = sock.getpeername()[0]
+        except OSError:
+            peer_host = None
+        if peer_host is not None and peer_host in self._banned_hosts:
+            log("net:tcp", f"refusing inbound from banned host {peer_host}")
+            sock.close()
+            return
         ident = self._identity
         duplex = TcpDuplex(sock, is_client=False, identity=ident)
         if ident is None and self._identity is not None:
@@ -392,17 +546,60 @@ class TcpSwarm(Swarm):
             log("net:tcp", "dropping pre-identity inbound connection")
             duplex.close()
             return
-        self._duplexes.append(duplex)
+        if (
+            duplex.peer_identity is not None
+            and duplex.peer_identity in self._banned_ids
+        ):
+            log(
+                "net:tcp",
+                f"refusing inbound redial from banned peer "
+                f"{duplex.peer_identity[:6]}",
+            )
+            duplex.close()
+            return
+        self._track(duplex)
         if not duplex.closed and self._cb is not None:
-            self._cb(duplex, ConnectionDetails(client=False))
+            details = ConnectionDetails(client=False)
+            details._on_ban = lambda: self._record_ban(duplex)
+            self._cb(duplex, details)
 
-    def connect(self, address: Tuple[str, int]) -> None:
-        sock = socket.create_connection(address, timeout=10)
+    def _dial(self, address: Tuple[str, int]) -> TcpDuplex:
+        """One dial + handshake (supervisor thread). Raises OSError on
+        failure so the supervisor schedules a backoff retry."""
+        sock = socket.create_connection(address, timeout=dial_timeout_s())
         sock.settimeout(None)
         duplex = TcpDuplex(sock, is_client=True, identity=self._identity)
-        self._duplexes.append(duplex)
+        if duplex.closed:
+            raise OSError("handshake failed")
+        if (
+            duplex.peer_identity is not None
+            and duplex.peer_identity in self._banned_ids
+        ):
+            duplex.close()
+            self._banned_addrs.add(address)  # stop the session too
+            raise OSError("peer identity is banned")
+        self._track(duplex)
+        return duplex
+
+    def _deliver_outbound(
+        self, duplex: TcpDuplex, details: ConnectionDetails
+    ) -> None:
+        try:
+            address = duplex._sock.getpeername()
+        except OSError:  # died between dial and deliver
+            address = None
+        details._on_ban = lambda: self._record_ban(duplex, address)
         if not duplex.closed and self._cb is not None:
-            self._cb(duplex, ConnectionDetails(client=True))
+            self._cb(duplex, details)
+
+    def connect(self, address: Tuple[str, int]):
+        """Supervised dial: registers `address` with the session
+        supervisor and returns its Session immediately. A failed dial
+        enqueues a jittered retry and surfaces through the
+        supervisor's status hook (`swarm.supervisor.on_status`)
+        instead of raising into the caller; a dropped connection
+        redials until `reconnect(False)`/`ban()`."""
+        return self.supervisor.connect(tuple(address))
 
     # discovery is external (reference: hyperswarm); topics are no-ops here
     def join(self, discovery_id: str, options=None) -> None:
@@ -421,10 +618,14 @@ class TcpSwarm(Swarm):
         self._cb = cb
 
     def destroy(self) -> None:
-        self._destroyed = True
+        with self._dlock:
+            self._destroyed = True  # _track closes later arrivals
+        self.supervisor.stop()  # no redial races the teardown below
         try:
             self._server.close()
         except OSError:
             pass
-        for d in list(self._duplexes):
+        with self._dlock:
+            live = list(self._duplexes)
+        for d in live:
             d.close()

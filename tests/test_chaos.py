@@ -1,0 +1,692 @@
+"""Chaos suite: supervised redial, keepalive half-open detection, and
+deterministic fault injection (net/resilience.py, net/faults.py).
+
+The availability contract — "the peer redials and resyncs from its
+cursor" — is exercised here the only way it can be trusted: with a
+SEEDED fault schedule (same seed -> same frame-level fates) driving
+kill / heal / partition / drop / duplicate faults against real TCP
+repos, a loopback twin pinning the converged state bit-identically, and
+no manual re-`connect()` anywhere after the first dial."""
+
+import os
+import random
+import socket as sockmod
+import threading
+import time
+
+import pytest
+
+from hypermerge_tpu.net.faults import (
+    DELIVER,
+    DROP,
+    DUP,
+    FaultDuplex,
+    FaultPlan,
+    FaultSwarm,
+    parse_fault_spec,
+)
+from hypermerge_tpu.net.resilience import (
+    BACKOFF,
+    CONNECTED,
+    STOPPED,
+    Backoff,
+)
+from hypermerge_tpu.net.swarm import ConnectionDetails
+from hypermerge_tpu.net.tcp import TcpDuplex, TcpSwarm
+from hypermerge_tpu.repo import Repo
+
+from helpers import wait_until
+
+
+@pytest.fixture
+def fast_redial(monkeypatch):
+    monkeypatch.setenv("HM_REDIAL_BASE_MS", "20")
+    monkeypatch.setenv("HM_REDIAL_MAX_S", "0.25")
+
+
+def _free_port() -> int:
+    s = sockmod.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestBackoff:
+    def test_full_jitter_bounds_and_cap(self):
+        b = Backoff(base_s=0.1, max_s=1.0, rng=random.Random(7))
+        ceilings = [0.1, 0.2, 0.4, 0.8, 1.0, 1.0, 1.0]
+        for ceil in ceilings:
+            d = b.next_delay()
+            assert 0.0 <= d <= ceil, (d, ceil)
+        # deep attempts stay capped (and 2**n never overflows)
+        for _ in range(200):
+            assert 0.0 <= b.next_delay() <= 1.0
+
+    def test_reset_on_success(self):
+        b = Backoff(base_s=0.1, max_s=10.0, rng=random.Random(1))
+        for _ in range(6):
+            b.next_delay()
+        assert b.attempt == 6
+        b.reset()
+        assert b.attempt == 0
+        assert b.next_delay() <= 0.1  # back to the fast first retry
+
+    def test_jitter_is_jittered(self):
+        b = Backoff(base_s=1.0, max_s=1.0, rng=random.Random(3))
+        ds = {round(b.next_delay(), 6) for _ in range(16)}
+        assert len(ds) > 8  # full jitter, not a fixed schedule
+
+
+class TestFaultPlan:
+    def _fates(self, plan, n=400):
+        return [plan.frame_fate(tx=True) for _ in range(n)] + [
+            plan.frame_fate(tx=False) for _ in range(n)
+        ]
+
+    def test_same_seed_same_schedule(self):
+        mk = lambda: FaultPlan(
+            seed=42, drop_p=0.1, dup_p=0.1, delay_ms=(1, 5)
+        )
+        assert self._fates(mk()) == self._fates(mk())
+
+    def test_different_seed_different_schedule(self):
+        a = FaultPlan(seed=1, drop_p=0.3, dup_p=0.3)
+        b = FaultPlan(seed=2, drop_p=0.3, dup_p=0.3)
+        assert self._fates(a) != self._fates(b)
+
+    def test_events_fire_in_tick_order(self):
+        plan = FaultPlan(events=[(2, "kill"), (4, "heal"), (4, "clean")])
+        assert plan.advance() == []
+        assert plan.advance() == ["kill"] and plan.down
+        assert plan.advance() == []
+        assert plan.advance() == ["heal", "clean"]
+        assert not plan.down and not plan.lossy
+
+    def test_partition_blocks_one_direction(self):
+        plan = FaultPlan(events=[(1, "partition_tx"), (2, "heal")])
+        plan.advance()
+        assert plan.frame_fate(tx=True)[0] == DROP
+        assert plan.frame_fate(tx=False)[0] == DELIVER
+        plan.advance()
+        assert plan.frame_fate(tx=True)[0] == DELIVER
+
+    def test_partition_consumes_rng(self):
+        """A partition window must not SHIFT the post-heal schedule:
+        blocked frames still consume the RNG stream."""
+        a = FaultPlan(seed=9, drop_p=0.5)
+        b = FaultPlan(seed=9, drop_p=0.5, events=[(1, "partition_tx"),
+                                                  (2, "heal")])
+        b.advance()
+        for _ in range(100):  # b's frames drop, but the stream advances
+            a.frame_fate(tx=True)
+            b.frame_fate(tx=True)
+        b.advance()
+        assert [a.frame_fate(tx=True) for _ in range(100)] == [
+            b.frame_fate(tx=True) for _ in range(100)
+        ]
+
+    def test_parse_spec(self):
+        plan = parse_fault_spec(
+            "seed=7,drop=0.02,dup=0.01,delay=2:8,kill@30,heal@50,tick=250"
+        )
+        assert plan.seed == 7 and plan.drop_p == 0.02
+        assert plan.dup_p == 0.01 and plan.delay_ms == (2.0, 8.0)
+        assert plan.tick_ms == 250
+        assert plan.events == [(30, "kill"), (50, "heal")]
+
+    def test_parse_spec_rejects_junk(self):
+        with pytest.raises(ValueError):
+            parse_fault_spec("explode@3")
+        with pytest.raises(ValueError):
+            parse_fault_spec("warp=9")
+
+
+class TestFaultDuplex:
+    def test_drop_and_dup(self):
+        from hypermerge_tpu.net.duplex import duplex_pair
+
+        a, b = duplex_pair()
+        got = []
+        b.on_message(got.append)
+        fa = FaultDuplex(a, FaultPlan(drop_p=1.0))
+        fa.send({"x": 1})
+        assert got == [] and fa.stats["frames_dropped_injected"] == 1
+
+        a2, b2 = duplex_pair()
+        got2 = []
+        b2.on_message(got2.append)
+        fa2 = FaultDuplex(a2, FaultPlan(dup_p=1.0))
+        fa2.send({"x": 2})
+        assert got2 == [{"x": 2}, {"x": 2}]
+
+    def test_rx_buffering_until_subscribe(self):
+        from hypermerge_tpu.net.duplex import duplex_pair
+
+        a, b = duplex_pair()
+        fb = FaultDuplex(b, FaultPlan())
+        a.send({"early": True})
+        got = []
+        fb.on_message(got.append)
+        assert got == [{"early": True}]
+
+    def test_delay_never_reorders(self):
+        """Injected latency rides a FIFO delay line: frames leave in
+        arrival order even when a later frame draws a shorter delay —
+        no real transport reorders, so the harness must not either."""
+        from hypermerge_tpu.net.duplex import duplex_pair
+
+        a, b = duplex_pair()
+        got = []
+        b.on_message(got.append)
+        fa = FaultDuplex(a, FaultPlan(seed=5, delay_ms=(1, 20)))
+        n = 30
+        for i in range(n):
+            fa.send({"i": i})
+        wait_until(lambda: len(got) == n, timeout=10)
+        assert [m["i"] for m in got] == list(range(n))
+
+
+class TestSupervisor:
+    def test_failed_dial_enqueues_retry_not_raise(self, fast_redial):
+        """The old `connect` raised OSError into the caller; now a dead
+        address backs off, surfaces status, and connects as soon as a
+        listener appears."""
+        port = _free_port()
+        sb = TcpSwarm()
+        states = []
+        sb.supervisor.on_status(
+            lambda s, state, info: states.append(state)
+        )
+        session = sb.connect(("127.0.0.1", port))  # nothing listening
+        wait_until(lambda: session.failures >= 2)
+        assert BACKOFF in states
+        sa = TcpSwarm(port=port)  # listener appears late
+        got = []
+        sa.on_connection(lambda d, det: got.append(d))
+        wait_until(lambda: session.state == CONNECTED)
+        assert session.connects == 1 and session.failures >= 2
+        sb.destroy()
+        sa.destroy()
+
+    def test_redial_after_drop_and_dedup(self, fast_redial):
+        """A dropped connection redials with no manual connect; closed
+        duplexes leave _duplexes (the churn leak)."""
+        sa, sb = TcpSwarm(), TcpSwarm()
+        accepted = []
+        sa.on_connection(lambda d, det: accepted.append(d))
+        session = sb.connect(sa.address)
+        cycles = 4
+        for i in range(cycles):
+            wait_until(lambda i=i: session.connects == i + 1)
+            # let the LISTENER finish its inbound handshake before the
+            # drop, or that accept never materializes
+            wait_until(lambda i=i: len(accepted) == i + 1)
+            wait_until(lambda: session.duplex and not session.duplex.closed)
+            session.duplex.close()  # hard drop; supervisor redials
+        wait_until(lambda: session.connects == cycles + 1)
+        assert sb.supervisor.stats["reconnects"] == cycles
+        # every closed duplex left the tracking lists
+        wait_until(lambda: len(sb._duplexes) <= 1)
+        wait_until(lambda: len(sa._duplexes) <= 1)
+        wait_until(lambda: len(accepted) == cycles + 1)
+        sb.destroy()
+        sa.destroy()
+
+    def test_connect_is_idempotent(self, fast_redial):
+        sa, sb = TcpSwarm(), TcpSwarm()
+        s1 = sb.connect(sa.address)
+        s2 = sb.connect(sa.address)
+        assert s1 is s2  # one session per address, kicked not duplicated
+        sb.destroy()
+        sa.destroy()
+
+    def test_reconnect_false_stops_session(self, fast_redial):
+        """ConnectionDetails.reconnect(False) — recorded forever, now
+        finally consulted: the session stops instead of redialing."""
+        sa, sb = TcpSwarm(), TcpSwarm()
+        session = sb.connect(sa.address)
+        wait_until(lambda: session.details is not None)
+        session.details.reconnect(False)
+        session.duplex.close()
+        wait_until(lambda: session.state == STOPPED)
+        assert session.stop_reason == "reconnect disallowed"
+        time.sleep(0.2)
+        assert session.connects == 1  # no further dials
+        sb.destroy()
+        sa.destroy()
+
+    def test_reconnect_false_during_backoff_stops(self, fast_redial):
+        """reconnect(False) set on session.details while the session is
+        between connections (backoff window) must stop the next dial —
+        each dial builds fresh details, so the loop head re-consults
+        the previous connection's."""
+        sa, sb = TcpSwarm(), TcpSwarm()
+        session = sb.connect(sa.address)
+        wait_until(lambda: session.details is not None)
+        sa.destroy()  # server gone: session will drop into backoff
+        wait_until(lambda: session.state == BACKOFF, timeout=10)
+        session.details.reconnect(False)  # stop signal mid-backoff
+        session.kick()
+        wait_until(lambda: session.state == STOPPED)
+        assert session.stop_reason == "reconnect disallowed"
+        sb.destroy()
+
+    def test_self_connection_does_not_redial_loop(self, fast_redial):
+        """Network._on_connection rejects a self-connection with
+        reconnect(False); the supervisor must honor it — before this
+        layer existed the one-shot dial just died, but a naive redial
+        loop would hammer the repo's own listener forever."""
+        ra = Repo(memory=True)
+        sa = TcpSwarm()
+        ra.set_swarm(sa)
+        session = sa.connect(sa.address)
+        wait_until(lambda: session.state == STOPPED)
+        assert session.stop_reason == "reconnect disallowed"
+        dials = sa.supervisor.stats["dials"]
+        time.sleep(0.3)
+        assert sa.supervisor.stats["dials"] == dials  # loop is dead
+        ra.close()
+
+
+class TestBan:
+    def test_banned_peer_inbound_redial_refused(self, fast_redial):
+        """ban() on an inbound connection's details records the proven
+        identity; the peer's next inbound redial is dropped at ACCEPT
+        time (it used to be accepted unconditionally)."""
+        sa = TcpSwarm(identity=os.urandom(32))
+        sb = TcpSwarm(identity=os.urandom(32))
+        accepted = []
+
+        def on_conn(duplex, details):
+            accepted.append((duplex, details))
+            if len(accepted) == 1:
+                details.ban()  # first contact: ban the peer
+                duplex.close()
+
+        sa.on_connection(on_conn)
+        session = sb.connect(sa.address)
+        wait_until(lambda: len(accepted) == 1)
+        assert accepted[0][0].peer_identity in sa._banned_ids
+        # the supervisor keeps redialing (B doesn't know it's banned);
+        # every redial must die at accept, never reach the callback
+        wait_until(lambda: session.connects >= 3)
+        assert len(accepted) == 1
+        sb.destroy()
+        sa.destroy()
+
+    def test_ban_on_outbound_stops_session(self, fast_redial):
+        sa, sb = TcpSwarm(), TcpSwarm()
+        session = sb.connect(sa.address)
+        wait_until(lambda: session.details is not None)
+        session.details.ban()  # severs the live connection itself
+        wait_until(lambda: session.duplex.closed)
+        wait_until(lambda: session.state == STOPPED)
+        assert session.stop_reason == "peer banned"
+        assert sa.address in sb._banned_addrs
+        sb.destroy()
+        sa.destroy()
+
+    def test_anonymous_inbound_ban_uses_host(self, fast_redial):
+        """Without identity auth the peer host is the only stable key:
+        ban() on an anonymous inbound connection must still take
+        effect (it recorded nothing before and the redial was accepted
+        unconditionally forever)."""
+        sa, sb = TcpSwarm(), TcpSwarm()  # no identities
+        accepted = []
+
+        def on_conn(duplex, details):
+            accepted.append(duplex)
+            if len(accepted) == 1:
+                details.ban()
+
+        sa.on_connection(on_conn)
+        session = sb.connect(sa.address)
+        wait_until(lambda: len(accepted) == 1)
+        wait_until(lambda: accepted[0].closed)  # ban severed it
+        assert "127.0.0.1" in sa._banned_hosts
+        # redials die at accept (before any handshake), never reaching
+        # the callback
+        wait_until(lambda: session.failures + session.connects >= 3)
+        assert len(accepted) == 1
+        sb.destroy()
+        sa.destroy()
+
+    def test_connect_after_stopped_session_starts_fresh(
+        self, fast_redial
+    ):
+        """connect() on an address whose session STOPPED must start a
+        fresh session (the old thread exited; kick() would wake
+        nobody and the caller would wait forever)."""
+        sa, sb = TcpSwarm(), TcpSwarm()
+        s1 = sb.connect(sa.address)
+        wait_until(lambda: s1.details is not None)
+        s1.details.reconnect(False)
+        s1.duplex.close()
+        wait_until(lambda: s1.state == STOPPED)
+        s2 = sb.connect(sa.address)
+        assert s2 is not s1
+        wait_until(lambda: s2.state == CONNECTED)
+        sb.destroy()
+        sa.destroy()
+
+
+class TestKeepalive:
+    def test_half_open_detected_within_budget(self, monkeypatch):
+        """A peer with the socket open but nothing flowing (machine
+        gone, NAT timeout, stalled reader) must be shed within
+        2 * HM_NET_PING_S * HM_NET_PING_MISSES — not at the 64MB
+        outbox bound."""
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0.2")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "2")
+        a, b = sockmod.socketpair()
+        t0 = time.monotonic()
+        d = TcpDuplex(a)
+        # b: socket open, never reads, never writes
+        wait_until(lambda: d.closed, timeout=5)
+        elapsed = time.monotonic() - t0
+        assert elapsed <= 2 * 0.2 * 2 + 0.5, elapsed
+        b.close()
+
+    def test_half_open_bound_holds_at_miss_budget_one(self, monkeypatch):
+        """The documented bound (2 * P * M) must hold at M=1 too: shed
+        lands ON the Nth unanswered probe, by (M+1)*P."""
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0.2")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "1")
+        a, b = sockmod.socketpair()
+        t0 = time.monotonic()
+        d = TcpDuplex(a)
+        wait_until(lambda: d.closed, timeout=5)
+        assert time.monotonic() - t0 <= 2 * 0.2 * 1 + 0.5
+        b.close()
+
+    def test_healthy_idle_pair_stays_up(self, monkeypatch):
+        """Ping/pong keeps an IDLE but healthy pair alive well past the
+        miss budget."""
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0.15")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "1")
+        a, b = sockmod.socketpair()
+        da, db = TcpDuplex(a), TcpDuplex(b)
+        got = []
+        db.on_message(got.append)
+        time.sleep(1.2)  # ~8 ping periods, miss budget 1
+        assert not da.closed and not db.closed
+        assert got == []  # keepalive frames never reach subscribers
+        da.send({"still": "works"})
+        wait_until(lambda: got == [{"still": "works"}])
+        da.close()
+        db.close()
+
+    def test_keepalive_shed_redial_resyncs(self, fast_redial, monkeypatch):
+        """Integration: an established repo link goes half-open (the
+        listener's inbound processing wedges, so it stops answering
+        pings); BOTH ends' keepalives shed, the dialer's supervisor
+        redials, and replication resyncs from cursors — counted by
+        ReplicationManager.stats."""
+        monkeypatch.setenv("HM_NET_PING_S", "0.25")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "1")
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"v": 1})
+        assert rb.open(url).value(timeout=10)["v"] == 1
+        wait_until(lambda: len(sa._duplexes) == 1)
+        wedged = sa._duplexes[0]
+        # wedge the listener side's reader: takes effect on the next
+        # inbound frame, after which A never pongs (nor processes) —
+        # B's writes pile up unread behind an open socket, the classic
+        # half-open shape
+        stall = threading.Event()
+
+        def wedge(_n):
+            stall.wait(3600)
+            return None  # reader sees EOF once the test releases it
+
+        wedged._read_exact = wedge
+        t0 = time.monotonic()
+        # keepalive sheds the wedged duplex (A's probes go unanswered),
+        # NOT the 64MB outbox bound; the dialer sees the close and
+        # redials; replication renegotiates from cursors
+        wait_until(lambda: wedged.closed, timeout=10)
+        assert time.monotonic() - t0 < 2 * 0.25 * 1 + 5
+        wait_until(
+            lambda: rb.back.network.replication.stats["resyncs"] >= 1,
+            timeout=10,
+        )
+        assert sb.supervisor.stats["reconnects"] >= 1
+        # the restored link replicates in the direction the wedge had
+        # silenced (B -> A)
+        rb.change(url, lambda d: d.__setitem__("v", 2))
+        wait_until(lambda: ra.doc(url).get("v") == 2, timeout=15)
+        stall.set()
+        ra.close()
+        rb.close()
+
+
+def _apply_script(repo_a, repo_b, url, lo, hi):
+    for i in range(lo, hi):
+        repo_a.change(url, lambda d, i=i: d["a"].append(i))
+        repo_b.change(url, lambda d, i=i: d["b"].append(i))
+
+
+def _wait_converged(ra, rb, url, want, timeout=60):
+    """Converge or fail with the full churn state (which side diverged,
+    peer/replication state) instead of a bare timeout."""
+    try:
+        wait_until(
+            lambda: ra.doc(url) == want and rb.doc(url) == want,
+            timeout=timeout,
+        )
+    except AssertionError:
+        def peers(r):
+            return [
+                (p.id[:6], p.is_connected)
+                for p in r.back.network.peers.values()
+            ]
+
+        raise AssertionError(
+            f"no reconvergence: want={want}\n"
+            f"  ra={ra.doc(url)}\n  rb={rb.doc(url)}\n"
+            f"  peers_a={peers(ra)} peers_b={peers(rb)}\n"
+            f"  repl_a={ra.back.network.replication.stats} "
+            f"repl_b={rb.back.network.replication.stats}"
+        )
+
+
+def _loopback_twin_state(n_total):
+    """The converged state an UNFAULTED pair reaches on the same edit
+    script — the bit-identical oracle for the chaos runs."""
+    from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+
+    hub = LoopbackHub()
+    ra, rb = Repo(memory=True), Repo(memory=True)
+    ra.set_swarm(LoopbackSwarm(hub))
+    rb.set_swarm(LoopbackSwarm(hub))
+    url = ra.create({"a": [], "b": []})
+    assert rb.open(url).value(timeout=10) is not None
+    _apply_script(ra, rb, url, 0, n_total)
+    want = {"a": list(range(n_total)), "b": list(range(n_total))}
+    wait_until(lambda: ra.doc(url) == want and rb.doc(url) == want)
+    state = ra.doc(url)
+    ra.close()
+    rb.close()
+    return state
+
+
+class TestChaosConvergence:
+    @pytest.mark.parametrize("live", ["1", "0"])
+    def test_kill_heal_reconverges_bit_identical(
+        self, live, fast_redial, monkeypatch
+    ):
+        """The tier-1 deterministic chaos test: a seeded kill-and-heal
+        FaultPlan severs the link mid-edit; the supervised redial (no
+        manual re-connect) restores replication and both repos
+        reconverge bit-identically to the loopback twin."""
+        monkeypatch.setenv("HM_LIVE", live)
+        plan = FaultPlan(seed=11, events=[(1, "kill"), (2, "heal")])
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa = TcpSwarm()
+        fb = FaultSwarm(TcpSwarm(), plan)
+        ra.set_swarm(sa)
+        rb.set_swarm(fb)
+        fb.connect(sa.address)
+        url = ra.create({"a": [], "b": []})
+        assert rb.open(url).value(timeout=10) is not None
+
+        n1, n2, n3 = 5, 5, 5
+        _apply_script(ra, rb, url, 0, n1)  # healthy phase
+        fb.tick()  # kill: link down, connection severed
+        wait_until(lambda: plan.down)
+        _apply_script(ra, rb, url, n1, n1 + n2)  # partitioned edits
+        fb.tick()  # heal: the next supervised redial goes through
+        _apply_script(ra, rb, url, n1 + n2, n1 + n2 + n3)
+
+        want = _loopback_twin_state(n1 + n2 + n3)
+        _wait_converged(ra, rb, url, want)
+        assert rb.back.network.replication.stats["resyncs"] >= 1
+        ra.close()
+        rb.close()
+
+    def test_lossy_then_kill_heal_fuzz(self, fast_redial, monkeypatch):
+        """Seeded drop/dup faults during the burst, then a clean
+        kill+heal cycle: the reconnect's from-scratch renegotiation
+        recovers whatever the lossy window ate, and the final state is
+        bit-identical to the loopback twin."""
+        monkeypatch.setenv("HM_LIVE", "1")
+        plan = FaultPlan(
+            seed=1337,
+            drop_p=0.05,
+            dup_p=0.05,
+            events=[(1, "clean"), (2, "kill"), (3, "heal")],
+        )
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa = TcpSwarm()
+        fb = FaultSwarm(TcpSwarm(), plan)
+        ra.set_swarm(sa)
+        rb.set_swarm(fb)
+        fb.connect(sa.address)
+        url = ra.create({"a": [], "b": []})
+        assert rb.open(url).value(timeout=10) is not None
+        n = 12
+        _apply_script(ra, rb, url, 0, n)  # under drop/dup faults
+        assert fb.stats["frames_dropped_injected"] >= 0  # counted
+        fb.tick()  # clean: loss stops
+        fb.tick()  # kill
+        fb.tick()  # heal -> redial renegotiates everything
+        want = _loopback_twin_state(n)
+        _wait_converged(ra, rb, url, want)
+        ra.close()
+        rb.close()
+
+
+class TestHalfWired:
+    def test_pending_prunes_dead_connections(self):
+        """Non-authority side: a connection that died without ever
+        receiving ConfirmConnection must leave _pending — otherwise
+        len(pending) > 1 forever and the next (only live) connection is
+        never optimistically wired: the half-wired wedge the chaos fuzz
+        exposed."""
+        from hypermerge_tpu.net.connection import PeerConnection
+        from hypermerge_tpu.net.duplex import duplex_pair
+        from hypermerge_tpu.net.peer import NetworkPeer
+
+        ready = []
+        p = NetworkPeer("idA", "idB", ready.append)  # B > A: no authority
+        d1a, _d1b = duplex_pair()
+        c1 = PeerConnection(d1a, True)
+        p.add_connection(c1)
+        assert p.connection is c1 and len(ready) == 1
+        c1.close()  # dropped before any ConfirmConnection arrived
+        assert p.connection is None
+        d2a, _d2b = duplex_pair()
+        c2 = PeerConnection(d2a, True)
+        p.add_connection(c2)
+        assert p.is_connected and p.connection is c2
+        assert len(ready) == 2
+
+    def test_info_timeout_reaps_half_wired_connection(self, monkeypatch):
+        """A connection whose Info exchange never completes (peer's
+        frame eaten by a faulty middlebox / injected fault) must be
+        closed by the reaper, not idle forever behind healthy
+        keepalives."""
+        from hypermerge_tpu.net.duplex import duplex_pair
+
+        from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+
+        monkeypatch.setenv("HM_INFO_TIMEOUT_S", "0.3")
+        repo = Repo(memory=True)
+        repo.set_swarm(LoopbackSwarm(LoopbackHub()))  # wires Network
+        a, b = duplex_pair()
+        b.on_message(lambda m: None)  # swallows Info, never replies
+        repo.back.network._on_connection(
+            a, ConnectionDetails(client=True)
+        )
+        wait_until(lambda: a.closed, timeout=5)
+        repo.close()
+
+
+class TestHmFaultEnv:
+    def test_hm_fault_wraps_every_swarm(self, fast_redial, monkeypatch):
+        """HM_FAULT=<spec> turns fault injection on for bench/soak runs
+        with no code change: Network.set_swarm wraps the swarm and the
+        ticker advances the plan on a wall clock; the system still
+        converges through the scheduled kill/heal cycle."""
+        monkeypatch.setenv("HM_FAULT", "seed=3,kill@4,heal@7,tick=50")
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        from hypermerge_tpu.net.faults import FaultSwarm
+
+        assert isinstance(ra.back.network.swarm, FaultSwarm)
+        sb.connect(sa.address)
+        url = ra.create({"v": 1})
+        assert rb.open(url).value(timeout=20)["v"] == 1
+        time.sleep(0.5)  # ride through the kill@4/heal@7 window
+        # continuous traffic (the soak shape): every edit after the
+        # heal must land, whichever one raced the resync window
+        for v in range(2, 6):
+            ra.change(url, lambda d, v=v: d.__setitem__("v", v))
+            time.sleep(0.2)
+        wait_until(lambda: rb.doc(url).get("v") == 5, timeout=20)
+        ra.close()
+        rb.close()
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_churn_soak_many_cycles(self, fast_redial, monkeypatch):
+        """Long soak: repeated lossy windows + kill/heal cycles under
+        continuous concurrent edits; every cycle must reconverge."""
+        monkeypatch.setenv("HM_LIVE", "1")
+        events = []
+        for c in range(4):
+            base = c * 3 + 1
+            events += [(base, "lossy"), (base + 1, "kill"),
+                       (base + 2, "heal"), (base + 2, "clean")]
+        plan = FaultPlan(seed=5, drop_p=0.03, dup_p=0.03, events=events)
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa = TcpSwarm()
+        fb = FaultSwarm(TcpSwarm(), plan)
+        ra.set_swarm(sa)
+        rb.set_swarm(fb)
+        fb.connect(sa.address)
+        url = ra.create({"a": [], "b": []})
+        assert rb.open(url).value(timeout=10) is not None
+        n = 0
+        for _cycle in range(4):
+            _apply_script(ra, rb, url, n, n + 8)
+            n += 8
+            for _ in range(3):
+                fb.tick()
+                time.sleep(0.3)
+        want = _loopback_twin_state(n)
+        _wait_converged(ra, rb, url, want, timeout=90)
+        assert rb.back.network.replication.stats["resyncs"] >= 2
+        ra.close()
+        rb.close()
