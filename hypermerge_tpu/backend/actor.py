@@ -106,7 +106,19 @@ class Actor:
                 )
                 return
             self.changes.append(change)
-            self.feed.append(blockmod.pack(change.to_json()))
+            try:
+                self.feed.append(blockmod.pack(change.to_json()))
+            except BaseException:
+                # ENOSPC/EIO mid-append: if the block never landed on
+                # the feed (storage only advances on success), the
+                # in-memory change list must not run ahead either — a
+                # phantom entry would break seq continuity for every
+                # later write and push the sidecar ahead of the block
+                # log. (If the failure struck AFTER the block landed —
+                # e.g. a listener — memory and disk already agree.)
+                if self.feed.length < len(self.changes):
+                    self.changes.pop()
+                raise
             if self._defer_cache is None:
                 self._sync_cache_locked()
         if self._defer_cache is not None:

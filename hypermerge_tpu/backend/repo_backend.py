@@ -63,17 +63,34 @@ class RepoBackend:
             memory_sig_storage_fn,
         )
 
+        from ..storage.durability import DurabilityManager
+
+        # durability tiers (HM_FSYNC, storage/durability.py): feed
+        # appends either fsync inline (tier 2), group-fsync on this
+        # manager's debounced flusher (tier 1), or not at all (tier 0 —
+        # crash-safe via recovery, not crash-durable)
+        self.durability = DurabilityManager()
         if memory:
             storage_fn = memory_storage_fn
             cache_fn = memory_column_storage_fn
             sig_fn = memory_sig_storage_fn
             db_path = ":memory:"
+            self._dirty_marker = None
+            was_dirty = False
         else:
-            storage_fn = file_storage_fn(os.path.join(path, "feeds"))
+            storage_fn = file_storage_fn(
+                os.path.join(path, "feeds"), durability=self.durability
+            )
             cache_fn = file_column_storage_fn(os.path.join(path, "feeds"))
             sig_fn = file_sig_storage_fn(os.path.join(path, "feeds"))
             os.makedirs(path, exist_ok=True)
             db_path = os.path.join(path, "repo.db")
+            # crash detection: the marker exists for exactly the life
+            # of a session that may write; close() removes it after
+            # every flusher drained. Present at open = the previous
+            # session crashed -> run whole-repo recovery below.
+            self._dirty_marker = os.path.join(path, "repo.dirty")
+            was_dirty = os.path.exists(self._dirty_marker)
         # corpus slab handle (storage/slab.py) when file-backed: the
         # backend owns its lifecycle (compaction on close)
         self._col_slab = getattr(cache_fn, "slab", None)
@@ -84,6 +101,34 @@ class RepoBackend:
         self.feed_info = FeedInfoStore(self.db)
         self.feeds = FeedStore(storage_fn, cache_fn, sig_fn)
         self.id: str = self.key_store.get_or_create("self.repo").public_key
+        # every secret key this repo ever persisted, by PUBLIC key —
+        # one query, not one per actor. Writable actors stay writable
+        # across restarts (the reference persists keys the same way):
+        # without this, a crashed session's lazily-signed feed tail
+        # could never be re-signed (sealed) OR replicated again.
+        self._actor_keys = {
+            p.public_key: p
+            for p in self.key_store.all_pairs().values()
+            if p.secret_key
+        }
+        # whole-repo crash recovery (storage/scrub.py): audit/truncate
+        # torn tails, repair the sig chains, reset sidecars that ran
+        # ahead, reconcile sqlite clock rows with feed reality. Runs
+        # BEFORE the clock mirror seeds and before any doc opens.
+        self.recovery_report: Optional[Dict] = None
+        if was_dirty and os.environ.get("HM_RECOVER", "1") != "0":
+            from ..storage.scrub import recover_repo
+
+            self.recovery_report = recover_repo(self)
+        if self._dirty_marker is not None:
+            from ..storage.faults import io_fsync, io_open
+
+            # the marker must be DURABLE: if a power cut erased it,
+            # reopen would silently skip recovery — and tier 0 depends
+            # on recovery-on-open to reconcile clocks with feeds
+            with io_open(self._dirty_marker, "wb") as fh:
+                io_fsync(fh)
+            self._fsync_dir(path)
         if os.environ.get("HM_CLOCK_MIRROR", "1") != "0":
             # device-resident ClockStore query twin (ops/clock_mirror.py):
             # writes buffer host-side, so this costs nothing until the
@@ -185,6 +230,19 @@ class RepoBackend:
             from .live import LiveApplyEngine
 
             self.live = LiveApplyEngine(self)
+
+    @staticmethod
+    def _fsync_dir(path: str) -> None:
+        """Durably record a directory entry (marker create). Advisory:
+        platforms without O_DIRECTORY fsync just skip it."""
+        try:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -320,6 +378,8 @@ class RepoBackend:
                 continue
             with self._lock:
                 self.actors.pop(actor_id, None)
+            self._actor_keys.pop(actor_id, None)
+            self.key_store.clear(actor_id)
             self.feed_info.remove(actor_id)
             self.feeds.remove(actor_id)
 
@@ -1312,7 +1372,19 @@ class RepoBackend:
             feed.public_key, feed.discovery_id, feed.writable
         )
 
+    def _save_actor_key(self, pair: keymod.KeyPair) -> None:
+        """Persist a writable actor's keypair (keys table, by public
+        key) so the feed stays writable across restarts — reopened
+        docs keep appending to THEIR actor, and crash recovery can
+        re-sign (seal) an orphaned unsigned tail."""
+        if self._actor_keys.get(pair.public_key) is not None:
+            return
+        self.key_store.set(pair.public_key, pair)
+        self._actor_keys[pair.public_key] = pair
+
     def _init_actor(self, pair: keymod.KeyPair) -> Actor:
+        if pair.secret_key is not None:
+            self._save_actor_key(pair)
         feed = self.feeds.create(pair)
         actor = Actor(
             feed, self._actor_notify, defer_cache=self._cache_syncs.mark
@@ -1369,7 +1441,13 @@ class RepoBackend:
         with self._lock:
             actor = self.actors.get(actor_id)
         if actor is None:
-            feed = self.feeds.open_feed(actor_id)
+            pair = self._actor_keys.get(actor_id)
+            # a persisted secret key re-binds writability on reopen
+            feed = (
+                self.feeds.create(pair)
+                if pair is not None
+                else self.feeds.open_feed(actor_id)
+            )
             actor = Actor(
                 feed, self._actor_notify, defer_cache=self._cache_syncs.mark
             )
@@ -1472,6 +1550,11 @@ class RepoBackend:
                 clocks[key[1]] = val
             else:
                 cursor_rows.append((key[1], key[2], val))
+        # durability ordering: a clock row must never COMMIT ahead of
+        # the feed bytes it describes (HM_FSYNC>=1 syncs dirty feed
+        # logs here; tier 0 relies on recovery-on-open clamping
+        # instead — storage/durability.py)
+        self.durability.barrier()
         with self.db.bulk():
             if clocks:
                 self.clocks.update_many(self.id, clocks)
@@ -1772,6 +1855,19 @@ class RepoBackend:
         if self.network is not None:
             self.network.close()
         self.feeds.close()
+        # final group fsync while files exist; a FAILED final sync
+        # leaves the crash marker in place so the next open recovers
+        durable = self.durability.close()
         if self._col_slab is not None:
             self._col_slab.close()
         self.db.close()
+        if (
+            durable
+            and self._dirty_marker is not None
+            and os.path.exists(self._dirty_marker)
+        ):
+            # clean close: every flusher drained, every store closed —
+            # the next open skips crash recovery
+            from ..storage.faults import io_remove
+
+            io_remove(self._dirty_marker)

@@ -86,6 +86,16 @@ def _flush_window_max_s() -> float:
     return float(os.environ.get("HM_REPL_FLUSH_MAX_MS", "25")) / 1e3
 
 
+def _antientropy_s() -> float:
+    """Anti-entropy sweep period (0 disables). The gap-driven protocol
+    only recovers a LOST replication frame at the next tail flush or a
+    reconnect renegotiation; a periodic FeedLength re-announce bounds
+    that staleness by the sweep interval — and a crash-recovered
+    (truncated) peer re-advertises its true lengths promptly instead
+    of waiting for new local writes."""
+    return float(os.environ.get("HM_ANTIENTROPY_S", "30"))
+
+
 class ReplicationManager:
     def __init__(
         self,
@@ -132,6 +142,13 @@ class ReplicationManager:
             merge=min,
             name="repl-flush",
         )
+        # anti-entropy sweep: periodic FeedLength re-announce to every
+        # verified peer (thread starts lazily on the first peer; a
+        # peerless manager never pays for it)
+        self._ae_interval = _antientropy_s()
+        self._ae_stop = threading.Event()
+        self._ae_thread: Optional[threading.Thread] = None
+        self.stats["antientropy_sweeps"] = 0
 
     # ------------------------------------------------------------------
 
@@ -152,6 +169,11 @@ class ReplicationManager:
             if peer.id in self._seen_closed:
                 self.stats["resyncs"] += 1
                 self._resync_t0[peer.id] = time.monotonic()
+            if self._ae_thread is None and self._ae_interval > 0:
+                self._ae_thread = threading.Thread(
+                    target=self._ae_loop, daemon=True, name="antientropy"
+                )
+                self._ae_thread.start()
         ch = conn.open_channel(CHANNEL)
         ch.subscribe(lambda msg: self._on_message(peer, msg))
         ch.send({
@@ -729,7 +751,44 @@ class ReplicationManager:
         flushing (tests and orderly shutdown)."""
         return self._flusher.flush_now(timeout)
 
+    # -- anti-entropy ---------------------------------------------------
+
+    def _ae_loop(self) -> None:
+        while not self._ae_stop.wait(self._ae_interval):
+            try:
+                self.sweep_now()
+            except Exception as e:  # a bad peer must not kill the sweep
+                log("replication", f"anti-entropy sweep failed: {e}")
+
+    def sweep_now(self) -> int:
+        """One anti-entropy pass NOW (the timer's body; tests call it
+        directly): re-announce our length for every feed each verified
+        peer replicates with us. Lengths are idempotent latest-state —
+        a peer that already matches ignores it; a peer that lost a
+        tail frame (app-layer loss on a surviving connection) or
+        truncated in crash recovery requests the gap. Returns frames
+        sent."""
+        with self._lock:
+            peers = list(self._peers)
+        sent = 0
+        for peer in peers:
+            if not peer.is_connected:
+                continue
+            with self._lock:
+                dids = list(self._verified.keys_with(peer))
+            for did in dids:
+                feed = self.feeds.by_discovery_id(did)
+                if feed is None:
+                    continue
+                msg = self._feed_length_msg(feed, peer)
+                if msg is not None:
+                    self._send(peer, msg)
+                    sent += 1
+        self.stats["antientropy_sweeps"] += 1
+        return sent
+
     def close(self) -> None:
+        self._ae_stop.set()
         # drains: tails marked before close still reach peers
         self._flusher.close()
 

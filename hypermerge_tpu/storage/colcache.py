@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..crdt.change import HEAD, ROOT, Action, Change
+from .faults import io_fsync, io_open, io_remove, io_replace
 
 ROW_FIELDS = 14
 PRED_FIELDS = 3
@@ -737,7 +738,11 @@ class FileColumnStorageV2:
         rec = pack_v2_record(rows, preds, table_lines, flag)
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         mode = "r+b" if os.path.exists(self.path) else "w+b"
-        with open(self.path, mode) as fh:
+        # a mid-write ENOSPC/EIO leaves a torn record past `end`;
+        # self._end only advances on success, so the next commit seeks
+        # back and overwrites it — and load() honors only records whose
+        # bytes are all present either way
+        with io_open(self.path, mode) as fh:
             fh.seek(end)  # overwrite any torn tail
             fh.write(rec)
             fh.truncate()
@@ -760,16 +765,16 @@ class FileColumnStorageV2:
         )
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         tmp = self.path + ".tmp"
-        with open(tmp, "wb") as fh:
+        with io_open(tmp, "wb") as fh:
             fh.write(blob)
             fh.flush()
-            os.fsync(fh.fileno())
-        os.replace(tmp, self.path)
+            io_fsync(fh)
+        io_replace(tmp, self.path)
         self._end = len(blob)
 
     def reset(self) -> None:
         if os.path.exists(self.path):
-            os.remove(self.path)
+            io_remove(self.path)
         self._end = 0
         self._counts = None
 
@@ -812,7 +817,7 @@ class SlabColumnStorage(FileColumnStorageV2):
                     raw = fh.read()
                 self._slab.append(KIND_IMAGE, self._name, raw)
                 try:
-                    os.remove(lp)
+                    io_remove(lp)
                 except OSError:
                     pass
         return self._load_v3_bytes(raw)
@@ -844,7 +849,7 @@ class SlabColumnStorage(FileColumnStorageV2):
             self._slab.append(KIND_TOMBSTONE, self._name, b"")
         lp = self._legacy_v2
         if lp is not None and os.path.exists(lp):
-            os.remove(lp)
+            io_remove(lp)
         self._counts = None
 
     def destroy(self) -> None:
@@ -1018,12 +1023,17 @@ class FeedColumnCache:
         with self._lock:
             self._ensure_loaded()
             if change is None:
-                self._storage.commit_change(
-                    np.zeros((0, ROW_FIELDS), np.int32),
-                    np.zeros((0, PRED_FIELDS), np.int32),
-                    self._take_pending(),
-                    1,
-                )
+                lines = self._take_pending()
+                try:
+                    self._storage.commit_change(
+                        np.zeros((0, ROW_FIELDS), np.int32),
+                        np.zeros((0, PRED_FIELDS), np.int32),
+                        lines,
+                        1,
+                    )
+                except BaseException:
+                    self._pending_tables = lines + self._pending_tables
+                    raise
                 self._commits_new.append(
                     (self._total_rows(), self._total_preds(), 0, 1)
                 )
@@ -1031,7 +1041,16 @@ class FeedColumnCache:
                 return
             rows, preds = self._encode(change)
             lines = self._take_pending()
-            self._storage.commit_change(rows, preds, lines, 0)
+            try:
+                self._storage.commit_change(rows, preds, lines, 0)
+            except BaseException:
+                # ENOSPC/EIO mid-commit: the interners already hold the
+                # new table entries, so the un-persisted lines MUST go
+                # back on the pending queue — dropping them would make
+                # every later commit reference table indices the file
+                # never defines (silently wrong values after reload)
+                self._pending_tables = lines + self._pending_tables
+                raise
             if len(rows):
                 self._row_chunks.append(rows)
                 self._n_rows_total += len(rows)

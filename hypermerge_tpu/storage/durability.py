@@ -1,0 +1,160 @@
+"""Durability tiers for the feed write path (HM_FSYNC).
+
+The hot append path was historically flush()-only: an acknowledged
+local edit reached the OS page cache but never the platter, so a power
+cut could drop acked writes (a kill -9 could not — the page cache
+outlives the process). HM_FSYNC picks the trade:
+
+  HM_FSYNC=0  (default) no fsync on the append path. Crash-SAFE but
+              not crash-DURABLE: every format heals torn tails and
+              recovery-on-open (storage/scrub.py) reconciles sqlite
+              against feed reality, so a crash never corrupts — it can
+              only lose the unfsynced tail.
+  HM_FSYNC=1  batched group fsync: appends mark their storage dirty
+              and a debounced flusher (HM_FSYNC_MS, default 25ms)
+              fsyncs every dirty feed log — one fsync per log per
+              window, not per append. An acked write is durable within
+              one window (or at the next sqlite store flush, whose
+              barrier syncs feeds FIRST — see below).
+  HM_FSYNC=2  fsync per append, before the .len sidecar write: an
+              acked append is durable when the call returns.
+
+Ordering invariants (the recoverable direction):
+  - feed log fsync happens BEFORE the .len/index sidecar describes it
+    (a sidecar ahead of the log is detected by the size check and
+    rescanned; the log is never behind what the sidecar promises).
+  - sqlite clock/cursor commits never land ahead of durable feed
+    bytes: the store flusher calls `barrier()` before committing, so
+    under tiers 1/2 a clock row can only describe blocks that are
+    already on the platter. (Tier 0 relies on recovery-on-open
+    clamping clock rows back to feed reality instead.)
+
+Sidecars (columnar slab, signature records) stay flush-only at every
+tier: they are derived data — blocks are the source of truth and every
+sidecar format detects-and-rebuilds on mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Set
+
+from ..utils.debug import log
+
+
+def fsync_tier() -> int:
+    try:
+        return int(os.environ.get("HM_FSYNC", "0"))
+    except ValueError:
+        return 0
+
+
+def _flush_window_s() -> float:
+    return float(os.environ.get("HM_FSYNC_MS", "25")) / 1e3
+
+
+class DurabilityManager:
+    """Owns the dirty-set + group-fsync flusher for tier 1 and the
+    pre-sqlite barrier for every tier. Storages call `mark_dirty(self)`
+    after an unfsynced append; anything with a `.sync()` method works.
+    The flusher thread starts lazily on the first dirty mark (tier 0
+    and tier 2 never pay for it)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._dirty: Set = set()
+        self._flusher = None
+        self._closed = False
+
+    @property
+    def tier(self) -> int:
+        return fsync_tier()
+
+    def mark_dirty(self, storage) -> None:
+        if self.tier < 1:
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._dirty.add(storage)
+            if self._flusher is None:
+                from ..utils.debounce import Debouncer
+
+                self._flusher = Debouncer(
+                    lambda _batch: self.sync_now(),
+                    window_s=_flush_window_s(),
+                    name="fsync",
+                )
+            self._flusher.mark("sync")
+
+    def sync_now(self) -> int:
+        """Group-fsync every dirty storage now. Returns the number
+        synced. A storage whose sync fails stays dirty — and the
+        flusher is re-marked so the retry does not wait for an
+        unrelated append (ENOSPC/EIO on fsync must not silently drop
+        durability). The FIRST failure re-raises after the pass so
+        callers that gate on durability (barrier) see it."""
+        with self._lock:
+            dirty = list(self._dirty)
+            self._dirty.clear()
+        n = 0
+        first_err: Optional[OSError] = None
+        for s in dirty:
+            try:
+                s.sync()
+                n += 1
+            except OSError as e:
+                log("storage:durability", f"sync failed: {e}")
+                if first_err is None:
+                    first_err = e
+                with self._lock:
+                    if not self._closed:
+                        self._dirty.add(s)
+                        if self._flusher is not None:
+                            self._flusher.mark("sync")
+        if first_err is not None:
+            raise first_err
+        return n
+
+    def barrier(self) -> None:
+        """Make every dirty feed durable BEFORE the caller commits
+        sqlite rows describing it (clocks-ahead-of-feeds is the
+        direction recovery cannot undo without truncating history).
+        RAISES on a failed fsync: the caller must NOT commit rows for
+        bytes that never reached the platter — the store debouncer
+        re-queues the batch and retries with backoff."""
+        if self.tier >= 1:
+            self.sync_now()
+
+    def flush_now(self, timeout: float = 5.0) -> bool:
+        """Settle the tier-1 flusher (tests/bench ack barrier)."""
+        f = self._flusher
+        if f is not None and not f.flush_now(timeout):
+            return False
+        self.sync_now()
+        return True
+
+    def close(self) -> bool:
+        """Final drain. Returns True when everything dirty reached the
+        platter — the backend only marks the repo CLEAN (removes the
+        crash marker) on a True close; a failed final sync leaves the
+        marker so the next open runs recovery."""
+        with self._lock:
+            self._closed = True
+            f = self._flusher
+            self._flusher = None
+        if f is not None:
+            f.close()
+        # final drain: anything still dirty gets one last sync
+        with self._lock:
+            dirty = list(self._dirty)
+            self._dirty.clear()
+        clean = True
+        for s in dirty:
+            try:
+                s.sync()
+            except OSError as e:
+                log("storage:durability", f"close sync failed: {e}")
+                clean = False
+        return clean

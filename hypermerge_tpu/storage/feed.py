@@ -23,8 +23,11 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..utils import keys as keymod
+from ..utils.debug import log
 from ..utils.ids import DiscoveryId, get_or_create
 from ..utils.queue import Queue
+from .durability import fsync_tier
+from .faults import io_fsync, io_open, io_remove
 
 
 class MemoryFeedStorage:
@@ -62,13 +65,20 @@ class FileFeedStorage:
     COUNT of ten thousand feeds (the sidecar-trust check), not their
     bytes. Any mismatch (torn append, out-of-band edit) falls back to a
     full scan. The per-block offset index is built lazily on first
-    `get`."""
+    `get`.
+
+    Durability (storage/durability.py HM_FSYNC): tier 2 fsyncs the log
+    inside `append` BEFORE the `.len` sidecar describes it; tier 1
+    marks this storage dirty with the repo's DurabilityManager, whose
+    group flusher calls `sync()`. Tier 0 (default) never fsyncs —
+    crash-safe (torn tails heal), not crash-durable."""
 
     _HDR = struct.Struct("<I")
     _LEN = struct.Struct("<QQ")  # block count, end offset
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, durability=None) -> None:
         self.path = path
+        self._durability = durability
         self._offsets: List[int] = []
         self._sizes: List[int] = []
         self._end = 0
@@ -92,7 +102,7 @@ class FileFeedStorage:
         return self.path + ".len"
 
     def _write_len(self) -> None:
-        with open(self._len_path(), "wb") as fh:
+        with io_open(self._len_path(), "wb") as fh:
             fh.write(self._LEN.pack(self._count, self._end))
 
     def _try_count_shortcut(self) -> bool:
@@ -147,18 +157,103 @@ class FileFeedStorage:
     def append(self, data: bytes) -> None:
         self._ensure_scan()
         mode = "r+b" if os.path.exists(self.path) else "w+b"
-        with open(self.path, mode) as fh:
+        tier = fsync_tier()
+        # exception safety under mid-write ENOSPC/EIO: the in-memory
+        # _offsets/_end/_count only advance AFTER every log byte landed
+        # (and, at tier 2, fsynced) — a raise leaves memory pointing at
+        # the pre-append end, so the next append seeks there, overwrites
+        # the torn tail, and truncates the stale bytes. The (possibly
+        # torn) on-disk tail is exactly what the scan already heals.
+        with io_open(self.path, mode) as fh:
             fh.seek(self._end)  # overwrite any torn tail...
             fh.write(self._HDR.pack(len(data)))
             fh.write(data)
             fh.truncate()  # ...and drop stale bytes beyond it, so a later
             # scan can't misparse leftovers as a phantom block
             fh.flush()
+            if tier >= 2:
+                # log durable BEFORE the .len sidecar describes it
+                io_fsync(fh)
         self._offsets.append(self._end + self._HDR.size)
         self._sizes.append(len(data))
         self._end += self._HDR.size + len(data)
         self._count = len(self._offsets)
-        self._write_len()
+        try:
+            self._write_len()
+        except OSError as e:
+            # the block is durable; the sidecar is advisory (a mismatch
+            # just costs the next open a rescan) — never fail the
+            # acked append over it
+            log("storage:feed", f".len write failed {self.path}: {e}")
+        if tier == 1 and self._durability is not None:
+            self._durability.mark_dirty(self)
+
+    def sync(self) -> None:
+        """Make the log (and its .len sidecar) durable: the tier-1
+        group-fsync target and the pre-sqlite barrier. Log first, .len
+        second — the sidecar must never describe unfsynced bytes."""
+        if not os.path.exists(self.path):
+            return
+        with io_open(self.path, "r+b") as fh:
+            io_fsync(fh)
+        if self._count is not None:
+            try:
+                self._write_len()
+                with io_open(self._len_path(), "r+b") as fh:
+                    io_fsync(fh)
+            except OSError as e:
+                log("storage:feed", f".len sync failed {self.path}: {e}")
+
+    def repair(self, write: bool = True) -> Dict[str, int]:
+        """Crash recovery: scan the log, physically truncate any torn
+        tail, rewrite the .len sidecar. Returns counters for the scrub
+        report; write=False only reports (tools/scrub.py --dry-run).
+        (Lazy healing would do all of this on the next append; repair
+        makes the on-disk state clean NOW so audits, byte accounting,
+        and read-only consumers see no leftovers.)"""
+        out = {"blocks": 0, "bytes_truncated": 0}
+        if not os.path.exists(self.path):
+            return out
+        # force a fresh scan (ignore any .len shortcut state)
+        self._scanned = False
+        self._count = None
+        self._init_checked = True
+        self._ensure_scan()
+        out["blocks"] = self._count or 0
+        size = os.path.getsize(self.path)
+        if size > self._end:
+            out["bytes_truncated"] = size - self._end
+            if write:
+                with io_open(self.path, "r+b") as fh:
+                    fh.truncate(self._end)
+        if write:
+            try:
+                self._write_len()
+            except OSError:
+                pass
+        return out
+
+    def truncate_to(self, count: int) -> int:
+        """Drop blocks beyond `count` (scrub's recovery for a READ-ONLY
+        feed whose unsigned tail cannot be trusted — the blocks
+        re-replicate from peers). Returns the number dropped."""
+        self._ensure_scan()
+        if count >= len(self._offsets):
+            return 0
+        dropped = len(self._offsets) - count
+        self._end = (
+            self._offsets[count] - self._HDR.size if count else 0
+        )
+        del self._offsets[count:]
+        del self._sizes[count:]
+        self._count = count
+        with io_open(self.path, "r+b") as fh:
+            fh.truncate(self._end)
+        try:
+            self._write_len()
+        except OSError:
+            pass
+        return dropped
 
     def get(self, index: int) -> bytes:
         self._ensure_scan()
@@ -174,7 +269,7 @@ class FileFeedStorage:
         """Remove the block log (and its .len index) from disk."""
         for p in (self.path, self._len_path()):
             if os.path.exists(p):
-                os.remove(p)
+                io_remove(p)
         self._offsets = []
         self._sizes = []
         self._end = 0
@@ -192,9 +287,11 @@ def memory_storage_fn(_name: str) -> MemoryFeedStorage:
     return MemoryFeedStorage()
 
 
-def file_storage_fn(root: str) -> StorageFn:
+def file_storage_fn(root: str, durability=None) -> StorageFn:
     def fn(name: str) -> FileFeedStorage:
-        return FileFeedStorage(os.path.join(root, name[:2], name))
+        return FileFeedStorage(
+            os.path.join(root, name[:2], name), durability=durability
+        )
 
     return fn
 

@@ -48,6 +48,7 @@ from typing import List, Optional, Tuple
 from ..utils import crypto
 from ..utils import keys as keymod
 from ..utils.debug import log
+from .faults import io_open, io_remove
 
 _SIG_CONTEXT = b"hm-feed-v1"
 _REC = struct.Struct("<Q32s64s")  # length, root, signature
@@ -138,7 +139,7 @@ class FileSigStorage:
 
     def append(self, length: int, root: bytes, sig: bytes) -> None:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        with open(self.path, "ab") as fh:
+        with io_open(self.path, "ab") as fh:
             fh.write(_REC.pack(length, root, sig))
 
     def load(self) -> List[Tuple[int, bytes, bytes]]:
@@ -151,9 +152,30 @@ class FileSigStorage:
             _REC.unpack_from(raw, i * _REC.size) for i in range(n)
         ]
 
+    def repair(self) -> int:
+        """Truncate a torn trailing fragment (load() already ignores
+        it; repair drops the bytes so audits and byte accounting see a
+        clean chain). Returns bytes dropped."""
+        if not os.path.exists(self.path):
+            return 0
+        size = os.path.getsize(self.path)
+        keep = (size // _REC.size) * _REC.size
+        if size > keep:
+            with io_open(self.path, "r+b") as fh:
+                fh.truncate(keep)
+        return size - keep
+
+    def rewrite(self, records: List[Tuple[int, bytes, bytes]]) -> None:
+        """Replace the whole chain (scrub dropping records that claim
+        blocks the log lost after a power cut)."""
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        with io_open(self.path, "wb") as fh:
+            for length, root, sig in records:
+                fh.write(_REC.pack(length, root, sig))
+
     def destroy(self) -> None:
         if os.path.exists(self.path):
-            os.remove(self.path)
+            io_remove(self.path)
 
     def close(self) -> None:  # pragma: no cover - nothing to do
         pass
@@ -290,9 +312,21 @@ class FeedIntegrity:
                     signable(index + 1, root),
                     keymod.decode(feed.secret_key),
                 )
-                self._ensure_records().append((index + 1, root, sig))
-                self._store.append(index + 1, root, sig)
-                self.unsigned_tail = False
+                try:
+                    self._store.append(index + 1, root, sig)
+                except OSError as e:
+                    # sig sidecar full/bad (ENOSPC/EIO): the BLOCK is
+                    # already durable and locally authored — degrade to
+                    # an unsigned tail (recoverable: seal()/record_for
+                    # re-signs) instead of failing the acked append
+                    log(
+                        "repo:integrity",
+                        f"sig append failed {self.public_key[:6]}: {e}",
+                    )
+                    self.unsigned_tail = True
+                else:
+                    self._ensure_records().append((index + 1, root, sig))
+                    self.unsigned_tail = False
             else:
                 self.unsigned_tail = True
 
@@ -331,10 +365,23 @@ class FeedIntegrity:
                 rec = (length, root, sig)
                 recs = self._ensure_records()
                 if not recs or recs[-1][0] < length:
-                    recs.append(rec)
-                    self._store.append(length, root, sig)
-                    if length == feed.length:
-                        self.unsigned_tail = False
+                    try:
+                        self._store.append(length, root, sig)
+                    except OSError as e:
+                        # serve the record anyway (it is valid); the
+                        # chain stays un-extended so a later seal or
+                        # sign retries persistence
+                        log(
+                            "repo:integrity",
+                            f"sig store failed "
+                            f"{self.public_key[:6]}: {e}",
+                        )
+                        if length == feed.length:
+                            self.unsigned_tail = True
+                    else:
+                        recs.append(rec)
+                        if length == feed.length:
+                            self.unsigned_tail = False
                 return rec
 
     # -- replication boundary ---------------------------------------------
@@ -380,7 +427,16 @@ class FeedIntegrity:
                 for leaf in new_leaves:
                     self._peaks.append(leaf)
             self._ensure_records().append((length, root, sig))
-            self._store.append(length, root, sig)
+            try:
+                self._store.append(length, root, sig)
+            except OSError as e:
+                # the blocks are stored and the in-memory chain serves
+                # this session; after a crash the uncovered tail is
+                # scrub-truncated and re-replicates from peers
+                log(
+                    "repo:integrity",
+                    f"sig store failed {self.public_key[:6]}: {e}",
+                )
 
     def range_proofs(self, feed, start: int, end: int):
         """Serve a sparse range: (proof_length, sig, [(block, proof)])

@@ -46,6 +46,9 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..utils.debug import log
+from .faults import io_fsync, io_open, io_remove, io_replace
+
 _MAGIC = b"HMSB"
 _VERSION = 1
 _HDR = struct.Struct("<4sI")
@@ -79,6 +82,10 @@ class CorpusSlab:
         self._mm_size = 0
         self._idx_fh = None
         self._closed = False
+        # crash-recovery accounting from the last _ensure_loaded: how
+        # many segments were repaired forward past the index, and
+        # whether the index itself was unusable (tools/scrub.py)
+        self.last_repair: Dict[str, int] = {}
 
     # -- index ----------------------------------------------------------
 
@@ -102,6 +109,13 @@ class CorpusSlab:
         # (crash between the slab append and the index append), or the
         # whole file when the index was unusable
         recovered = self._scan(pos, slab_size)
+        self.last_repair = {
+            "segments_recovered": len(recovered),
+            "idx_rebuilt": 0 if idx_ok else 1,
+            "bytes_ignored": max(0, slab_size - (
+                recovered[-1][2] + recovered[-1][3] if recovered else pos
+            )),
+        }
         if recovered:
             for kind, name, off, ln in recovered:
                 self._apply(kind, name, off, ln)
@@ -154,7 +168,7 @@ class CorpusSlab:
         if torn_at is None:
             return
         try:
-            with open(self.idx_path, "r+b") as fh:
+            with io_open(self.idx_path, "r+b") as fh:
                 fh.truncate(torn_at)
         except OSError:
             pass  # read-only media: the fragment stays, scan still heals
@@ -287,25 +301,35 @@ class CorpusSlab:
         if self._fh is None:
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
             fresh = not os.path.exists(self.path)
-            self._fh = open(self.path, "w+b" if fresh else "r+b")
+            self._fh = io_open(self.path, "w+b" if fresh else "r+b")
             if fresh:
                 self._fh.write(_HDR.pack(_MAGIC, _VERSION))
                 self._fh.flush()
                 self._end = self._fh.tell()
-            self._idx_fh = open(self.idx_path, "ab")
+            self._idx_fh = io_open(self.idx_path, "ab")
         return self._fh
 
     def append(self, kind: int, name: str, payload: bytes) -> None:
         with self._lock:
             self._ensure_loaded()
-            fh = self._writable()
             nb = name.encode("ascii")
             head = _SEG.pack(kind, len(nb)) + nb + _LEN.pack(len(payload))
-            fh.seek(self._end)  # overwrite any torn tail
-            fh.write(head)
-            fh.write(payload)
-            fh.truncate()
-            fh.flush()
+            # exception safety under mid-write ENOSPC/EIO: in-memory
+            # extents (_apply) only advance after the whole segment is
+            # on disk, and a failed write drops the persistent handles
+            # (their buffers may hold torn bytes in an ambiguous state)
+            # — the next append reopens, seeks the unchanged _end, and
+            # overwrites the torn tail, exactly like a crash would heal
+            try:
+                fh = self._writable()
+                fh.seek(self._end)  # overwrite any torn tail
+                fh.write(head)
+                fh.write(payload)
+                fh.truncate()
+                fh.flush()
+            except OSError:
+                self._close_files()
+                raise
             off = self._end + len(head)
             self._apply(kind, name, off, len(payload))
             if self._mm is not None:
@@ -315,13 +339,24 @@ class CorpusSlab:
             self._append_idx(kind, name, off, len(payload))
 
     def _append_idx(self, kind, name, off, ln) -> None:
-        if self._idx_fh is None:
-            self._idx_fh = open(self.idx_path, "ab")
-        nb = name.encode("ascii")
-        self._idx_fh.write(
-            _SEG.pack(kind, len(nb)) + nb + struct.pack("<QQ", off, ln)
-        )
-        self._idx_fh.flush()
+        # the index is advisory: a failed/torn idx append just means the
+        # next open repairs forward from the slab's segment headers
+        try:
+            if self._idx_fh is None:
+                self._idx_fh = io_open(self.idx_path, "ab")
+            nb = name.encode("ascii")
+            self._idx_fh.write(
+                _SEG.pack(kind, len(nb)) + nb + struct.pack("<QQ", off, ln)
+            )
+            self._idx_fh.flush()
+        except OSError as e:
+            log("storage:slab", f"idx append failed {self.idx_path}: {e}")
+            if self._idx_fh is not None:
+                try:
+                    self._idx_fh.close()
+                except OSError:
+                    pass
+                self._idx_fh = None
 
     def _rewrite_idx(self) -> None:
         # entries MUST be offset-ordered: _read_index treats any
@@ -333,7 +368,7 @@ class CorpusSlab:
             for kind, off, ln in segs
         )
         tmp = self.idx_path + ".tmp"
-        with open(tmp, "wb") as fh:
+        with io_open(tmp, "wb") as fh:
             for off, ln, kind, name in entries:
                 nb = name.encode("ascii")
                 fh.write(
@@ -341,7 +376,7 @@ class CorpusSlab:
                     + nb
                     + struct.pack("<QQ", off, ln)
                 )
-        os.replace(tmp, self.idx_path)
+        io_replace(tmp, self.idx_path)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -366,7 +401,7 @@ class CorpusSlab:
                 return False
             tmp = self.path + ".tmp"
             new_feeds: Dict[str, List[Tuple[int, int, int]]] = {}
-            with open(tmp, "wb") as fh:
+            with io_open(tmp, "wb") as fh:
                 fh.write(_HDR.pack(_MAGIC, _VERSION))
                 for name, segs in self._feeds.items():
                     if not segs:
@@ -380,10 +415,10 @@ class CorpusSlab:
                         out.append((kind, fh.tell() - ln, ln))
                     new_feeds[name] = out
                 fh.flush()
-                os.fsync(fh.fileno())
+                io_fsync(fh)
                 new_end = fh.tell()
             self._close_files()
-            os.replace(tmp, self.path)
+            io_replace(tmp, self.path)
             self._feeds = new_feeds
             self._end = new_end
             self._live_bytes = new_end - len(_HDR.pack(_MAGIC, _VERSION))
@@ -419,7 +454,7 @@ class CorpusSlab:
             self._close_files()
             for p in (self.path, self.idx_path):
                 if os.path.exists(p):
-                    os.remove(p)
+                    io_remove(p)
             self._feeds = {}
             self._loaded = True
             self._end = len(_HDR.pack(_MAGIC, _VERSION))

@@ -5,6 +5,16 @@ src/SqlDatabase.ts:11-22, src/migrations/0001_initial_schema.sql — tables
 Clocks/Keys/Cursors/Feeds). Python's stdlib sqlite3 replaces the
 better-sqlite3 native addon; a C++ store can swap in behind this module's
 API without touching callers.
+
+Crash model: sqlite's own journal makes each commit atomic and durable;
+for the simulated crash matrix (storage/faults.py CrashRecorder) every
+statement is journaled per-connection and lands in the event log as one
+batch per commit — a crash between statements of a transaction drops
+the whole transaction, exactly sqlite's semantics. Clock/cursor rows
+committed ahead of unfsynced feed bytes are the one skew sqlite cannot
+prevent; recovery-on-open (storage/scrub.py) reconciles them back to
+feed reality, and HM_FSYNC>=1 prevents the skew outright (the store
+flusher's durability barrier syncs feeds before committing).
 """
 
 from __future__ import annotations
@@ -12,6 +22,8 @@ from __future__ import annotations
 import contextlib
 import sqlite3
 import threading
+
+from .faults import active_recorder
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS clocks (
@@ -51,7 +63,23 @@ class SqlDatabase:
         self._defer_commit = 0
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            self._record("script", _SCHEMA, None)
             self._conn.commit()
+            self._record_commit()
+
+    def _record(self, kind: str, sql: str, params) -> None:
+        if self.path == ":memory:":
+            return
+        rec = active_recorder()
+        if rec is not None:
+            rec.db_stmt(self.path, kind, sql, params)
+
+    def _record_commit(self) -> None:
+        if self.path == ":memory:":
+            return
+        rec = active_recorder()
+        if rec is not None:
+            rec.db_commit(self.path)
 
     @contextlib.contextmanager
     def bulk(self):
@@ -67,19 +95,26 @@ class SqlDatabase:
                 self._defer_commit -= 1
                 if self._defer_commit == 0:
                     self._conn.commit()
+                    self._record_commit()
 
     def execute(self, sql: str, params=()) -> sqlite3.Cursor:
         with self._lock:
             cur = self._conn.execute(sql, params)
+            self._record("exec", sql, tuple(params))
             if not self._defer_commit:
                 self._conn.commit()
+                self._record_commit()
             return cur
 
     def executemany(self, sql: str, rows) -> None:
         with self._lock:
+            if active_recorder() is not None and self.path != ":memory:":
+                rows = [tuple(r) for r in rows]  # generators: journal too
             self._conn.executemany(sql, rows)
+            self._record("many", sql, rows)
             if not self._defer_commit:
                 self._conn.commit()
+                self._record_commit()
 
     def query(self, sql: str, params=()) -> list:
         with self._lock:

@@ -383,6 +383,17 @@ class KeyStore:
             self.set(name, pair)
         return pair
 
+    def all_pairs(self) -> Dict[str, keymod.KeyPair]:
+        """Every stored keypair in ONE query (the backend hydrates its
+        actor-key map from this at open — a per-actor SELECT would put
+        sqlite back on the bulk cold-open path)."""
+        return {
+            name: keymod.KeyPair(public_key=pub, secret_key=sec)
+            for name, pub, sec in self.db.query(
+                "SELECT name, public_key, secret_key FROM keys"
+            )
+        }
+
     def clear(self, name: str) -> None:
         self.db.execute("DELETE FROM keys WHERE name=?", (name,))
 

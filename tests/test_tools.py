@@ -261,3 +261,64 @@ def test_meta_tool_unknown_doc_times_out_to_null(tmp_path):
     out = _run(["tools/meta.py", path, unknown, "--timeout", "3"])
     assert out.returncode == 1
     assert out.stdout.strip().splitlines()[-1] == "null"
+
+
+def test_scrub_cli_repairs_crashed_repo(tmp_path):
+    from hypermerge_tpu.storage.feed import FileFeedStorage
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"n": 0})
+    for i in range(4):
+        repo.change(url, lambda d, i=i: d.__setitem__("n", i))
+    if repo.back.live is not None:
+        repo.back.live.flush_now()
+    actor = next(
+        iter(repo.back.docs[url.split("/")[-1]].clock)
+    )
+    repo.close()
+
+    # crash damage: a torn feed tail + the crash marker
+    feed_path = os.path.join(path, "feeds", actor[:2], actor)
+    with open(feed_path, "ab") as fh:
+        fh.write(b"\x40\x00\x00\x00torn")
+    open(os.path.join(path, "repo.dirty"), "wb").close()
+
+    out = _run(["tools/scrub.py", path, "--dry-run", "--json"])
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["bytes_truncated"] > 0, report
+    # dry run: damage (and the crash marker) still present
+    assert os.path.exists(os.path.join(path, "repo.dirty"))
+
+    out = _run(["tools/scrub.py", path, "--audit", "--json"])
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    assert report["bytes_truncated"] > 0, report
+    assert report["audit"]["not_ok"] == {}, report
+
+    # repaired for real: reopen reads the full doc, audits clean
+    out = _run(["tools/ls.py", path, "--audit"])
+    assert out.returncode == 0, out.stderr
+    assert "integrity=OK" in out.stdout
+    assert "scrub=" in out.stdout
+
+
+def test_ls_surfaces_recovery_status(tmp_path):
+    from hypermerge_tpu.storage.feed import FileFeedStorage
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"n": 0})
+    for i in range(5):
+        repo.change(url, lambda d, i=i: d.__setitem__("n", i))
+    if repo.back.live is not None:
+        repo.back.live.flush_now()
+    repo.close()
+
+    # unclean shutdown marker: the next open (ls itself) recovers
+    open(os.path.join(path, "repo.dirty"), "wb").close()
+    out = _run(["tools/ls.py", path])
+    assert out.returncode == 0, out.stderr
+    assert "crash recovery ran" in out.stdout
+    assert "scrub=ok" in out.stdout or "scrub=recovered" in out.stdout

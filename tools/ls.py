@@ -1,8 +1,15 @@
 """List every document in a repo directory: url, actor count, clock
-total, feed bytes on disk. (Reference tools/* ship six ts-node scripts;
-this is the inventory one.)
+total, feed bytes on disk, and per-doc crash/scrub status. (Reference
+tools/* ship six ts-node scripts; this is the inventory one.)
 
     python tools/ls.py /path/to/repo [--audit]
+
+The `scrub=` column surfaces crash damage without a full scrub
+(storage/scrub.py doc_status): `ok`, `recovered` (the last crash
+recovery repaired something for this doc's feeds — torn tails,
+sidecar resets, seals), `truncated-N-blocks` (recovery dropped N
+unverifiable blocks; they re-replicate from peers), or
+`unsigned_tail` (blocks currently beyond the last signature record).
 
 --audit additionally re-hashes each feed against its signed merkle
 records (storage/integrity.py) and flags tampering. A writable feed
@@ -27,6 +34,10 @@ from hypermerge_tpu.repo import Repo  # noqa: E402
 from hypermerge_tpu.storage.integrity import (  # noqa: E402
     AUDIT_TAMPERED,
     AUDIT_UNSIGNED_TAIL,
+)
+from hypermerge_tpu.storage.scrub import (  # noqa: E402
+    doc_status,
+    last_report,
 )
 from hypermerge_tpu.utils.ids import to_doc_url  # noqa: E402
 
@@ -58,7 +69,12 @@ def main() -> None:
     repo = Repo(path=args.repo)
     back = repo.back
     doc_ids = back.clocks.all_doc_ids(back.id)
-    print(f"repo {back.id[:8]}…  {len(doc_ids)} docs")
+    report = last_report(args.repo)
+    recovered = back.recovery_report is not None
+    print(
+        f"repo {back.id[:8]}…  {len(doc_ids)} docs"
+        + ("  (crash recovery ran on this open)" if recovered else "")
+    )
     for doc_id in doc_ids:
         cursor = back.cursors.get(back.id, doc_id)
         clock = back.clocks.get(back.id, doc_id)
@@ -66,7 +82,8 @@ def main() -> None:
         nbytes = sum(_feed_bytes(args.repo, a) for a in cursor)
         line = (
             f"{to_doc_url(doc_id)}  actors={len(cursor)} "
-            f"changes={total_changes} bytes={nbytes}"
+            f"changes={total_changes} bytes={nbytes} "
+            f"scrub={doc_status(back, doc_id, report)}"
         )
         if args.audit:
             # three-way status: OK / UNSIGNED-TAIL (crash-orphaned
