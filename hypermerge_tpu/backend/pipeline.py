@@ -27,10 +27,12 @@ backlog.
                       across visible devices via parallel/sharded.py
                       SlabRoundRobin, mesh-sharded, or single-device)
                       plus deferred doc init; never blocks on results.
-    fetch thread:     summary wire transfer + host parse for slab N
-                      overlapped with slab N+1's pack; the
+    fetch workers:    summary wire transfer + host parse for slab N
+                      overlapped with slab N+1's pack; with >1 device
+                      one worker per chip (bounded, HM_FETCH_WORKERS)
+                      so fetches overlap ACROSS chips too. The
                       materialization barrier (fetch_bulk_summaries)
-                      joins this thread and finds host arrays.
+                      joins them and finds host arrays.
 
 Failure contract: any stage raising aborts the whole pipeline — every
 queue drains, every worker joins (bounded), device refs drop, and the
@@ -84,17 +86,18 @@ def queue_depth() -> int:
 
 
 class FetchContext:
-    """Handle on the async fetch stage. The barrier
+    """Handle on the async fetch stage (one or more workers — with >1
+    device the fetch overlaps ACROSS chips: each worker can be pulling
+    a different chip's wire concurrently). The barrier
     (RepoBackend.fetch_bulk_summaries) joins it before decoding; a
     fetch error recorded during the overlap window re-raises there."""
 
     def __init__(self) -> None:
-        self.thread: Optional[threading.Thread] = None
+        self.threads: List[threading.Thread] = []
         self.error: Optional[BaseException] = None
 
     def join(self, timeout: float = _JOIN_S) -> None:
-        t = self.thread
-        if t is not None:
+        for t in self.threads:
             t.join(timeout)
             if t.is_alive():  # pragma: no cover - defensive
                 raise PipelineError("pipeline fetch stage did not drain")
@@ -129,6 +132,7 @@ class SlabPipeline:
         dispatch: Callable[[List[Any], Any], Any],
         fetch: Callable[[Any], None],
         slab: int,
+        fetch_workers: int = 1,
     ) -> None:
         self.docs = docs
         self.prefetch = prefetch
@@ -137,6 +141,7 @@ class SlabPipeline:
         self.dispatch = dispatch
         self.fetch = fetch
         self.slab = max(1, int(slab))
+        self.fetch_workers = max(1, int(fetch_workers))
         depth = queue_depth()
         self.pack_q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.disp_q: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -227,6 +232,9 @@ class SlabPipeline:
             while True:
                 item = self._get(self.fetch_q)
                 if item is _DONE:
+                    # recirculate the token so sibling workers (fetch
+                    # overlaps across chips) see it and drain too
+                    self._put(self.fetch_q, _DONE)
                     return
                 self.fetch(item)
         except _Abort:
@@ -248,16 +256,20 @@ class SlabPipeline:
         pack_t = threading.Thread(
             target=self._pack_loop, name="hm-pipe-pack", daemon=True
         )
-        fetch_t = threading.Thread(
-            target=self._fetch_loop,
-            args=(ctx,),
-            name="hm-pipe-fetch",
-            daemon=True,
-        )
-        ctx.thread = fetch_t
+        fetch_ts = [
+            threading.Thread(
+                target=self._fetch_loop,
+                args=(ctx,),
+                name=f"hm-pipe-fetch-{i}",
+                daemon=True,
+            )
+            for i in range(self.fetch_workers)
+        ]
+        ctx.threads = fetch_ts
         io_t.start()
         pack_t.start()
-        fetch_t.start()
+        for t in fetch_ts:
+            t.start()
         try:
             while True:
                 item = self._get(self.disp_q)
@@ -275,15 +287,20 @@ class SlabPipeline:
         pack_t.join(_JOIN_S)
         if self.error is not None:
             # drain so nothing pins batches/device refs, then take the
-            # fetch worker down too — the load failed as a unit
-            fetch_t.join(_JOIN_S)
+            # fetch workers down too — the load failed as a unit
+            for t in fetch_ts:
+                t.join(_JOIN_S)
             for q in (self.pack_q, self.disp_q, self.fetch_q):
                 while True:
                     try:
                         q.get_nowait()
                     except queue.Empty:
                         break
-            if io_t.is_alive() or pack_t.is_alive() or fetch_t.is_alive():
+            if (
+                io_t.is_alive()
+                or pack_t.is_alive()
+                or any(t.is_alive() for t in fetch_ts)
+            ):
                 raise PipelineError(  # pragma: no cover - defensive
                     f"pipeline stage '{self.error_stage}' failed and "
                     "workers did not drain"

@@ -837,14 +837,46 @@ class RepoBackend:
         stats = self.last_bulk_stats  # captured: the fetch worker can
         # outlive this load; its timings belong to THIS load's stats
 
+        # mesh-aware accounting: the scheduler (built here, before any
+        # dispatch, so the fetch stage can size itself) accumulates
+        # per-chip dispatch busy time across loads — snapshot now, diff
+        # after the run, so the stats carry THIS load's per-chip times
+        rr = self._slab_rr()
+        disp0 = list(rr.t_dispatch_chip) if rr is not None else None
+        slabs0 = list(rr.slabs_per_chip) if rr is not None else None
+
         def fetch(entry):
             t0 = now()
+            wire = entry[3]
             self._fetch_slab(entry)
+            dt = now() - t0
+            chip = None
+            if rr is not None and hasattr(wire, "devices"):
+                try:
+                    chip = rr.device_index(next(iter(wire.devices())))
+                except Exception:  # non-jax wire / foreign device
+                    chip = None
             with self._stats_lock:
                 stats["t_fetch_busy"] = round(
-                    stats.get("t_fetch_busy", 0.0) + now() - t0, 6
+                    stats.get("t_fetch_busy", 0.0) + dt, 6
                 )
+                if chip is not None:
+                    per = stats.setdefault(
+                        "t_fetch_chips", [0.0] * len(rr.devices)
+                    )
+                    per[chip] = round(per[chip] + dt, 6)
 
+        # fetch overlaps across chips: one worker per device (bounded —
+        # each worker is host-side parse + one transfer at a time)
+        workers = 1
+        if rr is not None:
+            workers = max(
+                1,
+                min(
+                    len(rr.devices),
+                    int(os.environ.get("HM_FETCH_WORKERS", "4")),
+                ),
+            )
         pipe = SlabPipeline(
             new_docs,
             prefetch=prefetch,
@@ -853,6 +885,7 @@ class RepoBackend:
             dispatch=dispatch,
             fetch=fetch,
             slab=slab,
+            fetch_workers=workers,
         )
         ctx = FetchContext()
         try:
@@ -861,6 +894,15 @@ class RepoBackend:
             if self._rr_value is not None:
                 # dispatching done: drop backpressure refs
                 self._rr_value.release()
+        if rr is not None:
+            with self._stats_lock:
+                stats["t_dispatch_chips"] = [
+                    round(b - a, 6)
+                    for a, b in zip(disp0, rr.t_dispatch_chip)
+                ]
+                stats["slabs_per_chip"] = [
+                    b - a for a, b in zip(slabs0, rr.slabs_per_chip)
+                ]
         self._fetch_ctx = ctx
         return memo_hits, fallbacks
 
@@ -1092,9 +1134,25 @@ class RepoBackend:
 
             devices = jax.devices()
             if len(devices) > 1:
-                from ..parallel.sharded import SlabRoundRobin
+                from ..parallel.sharded import (
+                    MeshBulkScheduler,
+                    SlabRoundRobin,
+                )
 
-                self._rr_value = SlabRoundRobin(devices)
+                try:
+                    from ..parallel.mesh import make_mesh
+
+                    # the mesh scheduler: identical streaming dispatch
+                    # (whole slabs per chip, same kernels). Resident
+                    # tracking OFF: the product barrier fetches per
+                    # slab on the overlapped fetch workers, so the
+                    # collective-reduction refs would pin every slab's
+                    # device wire with no consumer.
+                    self._rr_value = MeshBulkScheduler(
+                        make_mesh(), track_resident=False
+                    )
+                except Exception:
+                    self._rr_value = SlabRoundRobin(devices)
         except Exception as e:  # no usable backend: host path only
             log("repo:backend", f"no slab round-robin: {e}")
         return self._rr_value

@@ -53,3 +53,26 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def pad_to_multiple(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
+
+
+def device_topology() -> dict:
+    """Visible-device + mesh topology summary — the audit context a
+    multichip bench number needs to be interpretable on its own (the
+    bench embeds it in the JSON line; `tools/meta.py --devices` prints
+    it standalone). Includes whether the Pallas ICI remote-copy path is
+    live (`remote_copy_capable`) — CPU host-platform meshes always run
+    the lax-collective twin."""
+    import jax
+
+    from .sharded import remote_copy_capable
+
+    devs = jax.devices()
+    return {
+        "n_devices": len(devs),
+        "platform": devs[0].platform if devs else None,
+        "device_kind": devs[0].device_kind if devs else None,
+        "default_backend": jax.default_backend(),
+        "mesh": {"dp": len(devs), "sp": 1},
+        "ici_remote_copy": remote_copy_capable(),
+        "process_count": jax.process_count(),
+    }

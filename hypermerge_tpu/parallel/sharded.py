@@ -10,25 +10,43 @@ The reference processes documents one at a time on one Node thread
   over chips.
 - `sharded_clock_union` / `sharded_dominated`: GLOBAL-actor-indexed
   [D, A] clock matrices (ClockStore rows — BASELINE config 5 bulk
-  queries) sharded (dp, sp); the doc-axis reduction crosses shards, so
-  XLA inserts max-reduce collectives over ICI. NOT for kernel clock
-  outputs: MaterializeOut.clock is slot-LOCAL ([D, A_loc], a different
-  actor per slot per doc) — decode those with `local_clock_union`.
-- `step`: one full "merge step" combining materialize + local clock
-  union — what dryrun_multichip exercises end-to-end.
+  queries) sharded (dp, sp); the cross-shard doc-axis reduction is an
+  EXPLICIT `shard_map` collective (`lax.pmax`/`lax.pmin` over the mesh
+  axes — over ICI on hardware). NOT for kernel clock outputs:
+  MaterializeOut.clock is slot-LOCAL ([D, A_loc], a different actor per
+  slot per doc) — decode those with `local_clock_union`.
+- `step`: one full "merge step" — materialize + clock union as ONE
+  `shard_map` collective program (the per-shard kernel, the per-shard
+  scatter-max, and the cross-shard pmax all in one executable) — what
+  the driver's multichip entry exercises end-to-end.
 - `SlabRoundRobin`: the streaming-pipeline alternative to sharded
-  dispatch — whole slabs round-robin across devices with bounded
-  per-device in-flight queues, so chips run independent programs while
-  the host packs ahead (RepoBackend bulk loader, HM_PIPELINE=1).
+  dispatch — whole slabs round-robin (or least-loaded, HM_RR_LEAST_LOADED)
+  across devices with bounded per-device in-flight queues, so chips run
+  independent programs while the host packs ahead (RepoBackend bulk
+  loader, HM_PIPELINE=1). Tracks per-chip dispatch busy time.
+- `MeshBulkScheduler`: SlabRoundRobin's streaming married to the mesh —
+  whole slabs stay pinned per chip, and the CROSS-DOC reductions over
+  everything resident (clock union across every chip's slabs, the bulk
+  summary gather) run as one `shard_map` collective program over the
+  mesh instead of a host-side merge of per-device fetches. On real ICI
+  the gather rides a Pallas `make_async_remote_copy` ring
+  (`remote_copy_capable`); host-platform CPU meshes lower the same
+  program through `lax` collectives, so CPU CI pins the numerics.
+
+Every mesh program is built ONCE per (mesh, shape-bucket) key in a
+module program table (`_PROGRAMS`) — repeated calls reuse the jitted
+executable with zero retracing (`trace_counts` exposes per-key trace
+tallies for the regression tests).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict, Tuple
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.columnar import ColumnarBatch
@@ -39,6 +57,120 @@ from .mesh import doc_actor_sharding, doc_sharding, pad_to_multiple
 # rows must decode to action=PAD (flags=7), insert=0
 _N_ARGS = 11  # flags, slot, ctr, seq, obj, key, ref, value, psrc, ptgt, da
 _PAD_VALUES = (7, 0, 0, 0, -1, -1, -3, 0, -1, -1, -1)
+
+
+# ---------------------------------------------------------------------------
+# program table — ONE jitted program per (mesh, kind, shape bucket)
+#
+# The first cut of this module built a fresh `jax.jit` closure inside
+# every call (`local_clock_union`, `sharded_full`'s inner `fn`), so every
+# union/materialize paid a full retrace: jit caches per FUNCTION OBJECT,
+# and a new closure is a new function. The table below hoists every mesh
+# program behind a key; the jit object lives as long as the process and
+# its own shape-cache does the rest.
+
+_PROGRAMS: Dict[Tuple, Any] = {}
+trace_counts: Dict[Tuple, int] = {}
+
+
+def _program(key: Tuple, build: Callable[[], Any]) -> Any:
+    fn = _PROGRAMS.get(key)
+    if fn is None:
+        fn = build()
+        _PROGRAMS[key] = fn
+    return fn
+
+
+def _traced(key: Tuple, fn: Callable) -> Callable:
+    """Wrap a to-be-jitted python callable so each TRACE (not each call)
+    bumps trace_counts[key] — the retrace regression tests assert the
+    count stays at 1 across repeated same-shape calls."""
+
+    def wrapper(*args):
+        trace_counts[key] = trace_counts.get(key, 0) + 1
+        return fn(*args)
+
+    return wrapper
+
+
+def clear_program_cache() -> None:
+    """Test hook: drop every cached mesh program and trace tally."""
+    _PROGRAMS.clear()
+    trace_counts.clear()
+
+
+def remote_copy_capable(mesh: Optional[Mesh] = None) -> bool:
+    """True when the mesh's devices can run the Pallas
+    `make_async_remote_copy` ICI ring (real TPU chips with the pallas
+    TPU backend importable). Host-platform CPU meshes — the CI twin —
+    always lower the lax-collective variant instead. HM_ICI_PALLAS=0
+    forces the lax path on hardware too (A/B and escape hatch)."""
+    if os.environ.get("HM_ICI_PALLAS", "1") == "0":
+        return False
+    try:
+        devs = (
+            list(mesh.devices.flat) if mesh is not None else jax.devices()
+        )
+        if not devs or devs[0].platform != "tpu":
+            return False
+        from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+        return hasattr(pltpu, "make_async_remote_copy")
+    except Exception:
+        return False
+
+
+def _pallas_ring_gather(n_devices: int, rows: int, width: int, dtype):
+    """Pallas ring all-gather over the flattened mesh axis: each chip
+    DMAs its [rows, width] block to its right neighbor n-1 times
+    (`make_async_remote_copy`, double-buffered comm slots), assembling
+    the replicated [n*rows, width] output without touching the host.
+    Built only when `remote_copy_capable` — the lax.all_gather twin is
+    the numerics reference on CPU CI. The ring runs over the "dp" mesh
+    axis: `_gather_program` selects this path only when sp == 1, so dp
+    IS the flattened device ring."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(local_ref, out_ref, comm_ref, send_sem, recv_sem):
+        my_id = jax.lax.axis_index("dp")
+        right = jax.lax.rem(my_id + 1, n_devices)
+        out_ref[pl.ds(my_id * rows, rows), :] = local_ref[:]
+        comm_ref[0] = local_ref[:]
+        for step in range(n_devices - 1):
+            src = (my_id - step - 1) % n_devices
+            send_slot = step % 2
+            recv_slot = (step + 1) % 2
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_ref.at[send_slot],
+                dst_ref=comm_ref.at[recv_slot],
+                send_sem=send_sem.at[send_slot],
+                recv_sem=recv_sem.at[recv_slot],
+                device_id=(right,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdma.wait()
+            out_ref[pl.ds(src * rows, rows), :] = comm_ref[recv_slot]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, rows, width), dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n_devices * rows, width), dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0)
+        if hasattr(pltpu, "TPUCompilerParams")
+        else None,
+    )
 
 
 def shard_batch(batch: ColumnarBatch, mesh: Mesh):
@@ -80,17 +212,27 @@ def shard_batch(batch: ColumnarBatch, mesh: Mesh):
     return args, A, K, D_pad
 
 
+def _materialize_program(mesh: Mesh, A: int, K: int):
+    key = ("materialize", mesh, A, K)
+
+    def build():
+        sh = doc_sharding(mesh)
+        return jax.jit(
+            _traced(key, batched_kernel(A, K)),
+            in_shardings=(sh,) * _N_ARGS,
+            out_shardings=MaterializeOut(
+                *([sh] * len(MaterializeOut._fields))
+            ),
+        )
+
+    return _program(key, build)
+
+
 def _materialize_on_mesh(batch: ColumnarBatch, mesh: Mesh):
     """(out, doc_actors): the sharded batched replay plus the dp-sharded
     actor map it ran with (step reuses the map for the clock union)."""
     args, A, K, _ = shard_batch(batch, mesh)
-    fn = jax.jit(
-        batched_kernel(A, K),
-        in_shardings=(doc_sharding(mesh),) * _N_ARGS,
-        out_shardings=MaterializeOut(
-            *([doc_sharding(mesh)] * len(MaterializeOut._fields))
-        ),
-    )
+    fn = _materialize_program(mesh, A, K)
     with mesh:
         out = fn(*args)
     return out, args[-1]
@@ -103,6 +245,31 @@ def sharded_materialize(
     return _materialize_on_mesh(batch, mesh)[0]
 
 
+def _full_program(mesh: Mesh, A: int, K: int, N: int, lean: bool):
+    key = ("full", mesh, A, K, N, lean)
+
+    def build():
+        from ..ops.crdt_kernels import _summarize_wire
+
+        sh = doc_sharding(mesh)
+        kern = batched_kernel(A, K)
+
+        def fn(*xs):
+            out = kern(*xs)
+            return out, _summarize_wire(out, N, A, lean)
+
+        return jax.jit(
+            _traced(key, fn),
+            in_shardings=(sh,) * _N_ARGS,
+            out_shardings=(
+                MaterializeOut(*([sh] * len(MaterializeOut._fields))),
+                sh,
+            ),
+        )
+
+    return _program(key, build)
+
+
 def sharded_full(batch: ColumnarBatch, mesh: Mesh, lean: bool = False):
     """(MaterializeOut, summary wire) sharded over dp — the multi-chip
     twin of ops.crdt_kernels.run_batch_full, and the dispatch the PRODUCT
@@ -113,30 +280,10 @@ def sharded_full(batch: ColumnarBatch, mesh: Mesh, lean: bool = False):
     — callers holding authoritative host clocks only. Per-doc compute
     has no cross-doc data flow, so XLA compiles this with zero
     collectives — linear scaling over dp."""
-    from ..ops.crdt_kernels import _summarize_wire, batched_kernel
-
     args, A, K, _ = shard_batch(batch, mesh)
-    sh = doc_sharding(mesh)
-
-    def fn(*xs):
-        out = batched_kernel(A, K)(*xs)
-        return out, _summarize_wire(out, batch.n_rows, A, lean)
-
-    jfn = jax.jit(
-        fn,
-        in_shardings=(sh,) * _N_ARGS,
-        out_shardings=(
-            MaterializeOut(*([sh] * len(MaterializeOut._fields))),
-            sh,
-        ),
-    )
+    jfn = _full_program(mesh, A, K, batch.n_rows, lean)
     with mesh:
         return jfn(*args)
-
-
-@partial(jax.jit, static_argnames=())
-def _union_reduce(clocks):
-    return jnp.max(clocks, axis=0)
 
 
 def _pad_axes(arr, mesh: Mesh):
@@ -155,62 +302,240 @@ def _pad_axes(arr, mesh: Mesh):
     return arr, D, A
 
 
+def _union_program(mesh: Mesh):
+    """[D, A] (dp, sp)-sharded -> [A] sp-sharded union: per-shard doc
+    max, then an explicit pmax collective across the dp axis."""
+    key = ("union", mesh)
+
+    def build():
+        def f(c):
+            return jax.lax.pmax(jnp.max(c, axis=0), "dp")
+
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=P("dp", "sp"),
+                out_specs=P("sp"),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
 def sharded_clock_union(clocks, mesh: Mesh):
     """[D, A] -> [A] union across a (dp, sp)-sharded clock matrix whose
     columns are GLOBAL actor indices (ClockStore rows); the dp-axis
-    max-reduce becomes an ICI collective. Kernel clock outputs are
-    slot-local — use `local_clock_union` for those."""
+    max-reduce is an explicit shard_map `lax.pmax` — an ICI collective
+    on hardware. Kernel clock outputs are slot-local — use
+    `local_clock_union` for those."""
     arr, _D, A = _pad_axes(clocks, mesh)
-    sh = doc_actor_sharding(mesh)
-    arr = jax.device_put(arr, sh)
-    fn = jax.jit(
-        lambda c: jnp.max(c, axis=0),
-        in_shardings=sh,
-        out_shardings=NamedSharding(mesh, P("sp")),
-    )
+    arr = jax.device_put(arr, doc_actor_sharding(mesh))
+    fn = _union_program(mesh)
     with mesh:
         return fn(arr)[:A]
 
 
+def _dominated_program(mesh: Mesh):
+    """[D, A], [A] -> [D] bool: per-shard <= check, then an explicit
+    pmin collective ANDs the verdicts across the sp axis."""
+    key = ("dominated", mesh)
+
+    def build():
+        def f(c, q):
+            part = jnp.all(c <= q[None, :], axis=-1)
+            return jax.lax.pmin(part.astype(jnp.int32), "sp") > 0
+
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=(P("dp", "sp"), P("sp")),
+                out_specs=P("dp"),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
 def sharded_dominated(clocks, query, mesh: Mesh):
     """[D, A], [A] -> [D] bool: which docs' clocks the query dominates.
-    The actor-axis `all` reduction crosses sp shards."""
+    The actor-axis `all` reduction crosses sp shards (shard_map pmin)."""
     import numpy as np
 
     arr, D, A = _pad_axes(clocks, mesh)
     q = np.zeros((arr.shape[1],), arr.dtype)
     q[:A] = np.asarray(query)
-    csh = doc_actor_sharding(mesh)
-    qsh = NamedSharding(mesh, P("sp"))
-    arr = jax.device_put(arr, csh)
-    q = jax.device_put(q, qsh)
-    fn = jax.jit(
-        lambda c, qq: jnp.all(c <= qq[None, :], axis=-1),
-        in_shardings=(csh, qsh),
-        out_shardings=NamedSharding(mesh, P("dp")),
-    )
+    arr = jax.device_put(arr, doc_actor_sharding(mesh))
+    q = jax.device_put(q, NamedSharding(mesh, P("sp")))
+    fn = _dominated_program(mesh)
     with mesh:
         return fn(arr, q)[:D]
 
 
+def _scatter_union(clock, doc_actors, n_actors: int):
+    """Per-shard scatter-max of slot-local clocks into global actor
+    rows: [d, A_loc] x [d, A_loc] -> [n_actors]."""
+    return (
+        jnp.zeros(n_actors + 1, jnp.int32)
+        .at[jnp.where(doc_actors >= 0, doc_actors, n_actors).ravel()]
+        .max(jnp.where(doc_actors >= 0, clock, 0).ravel())[:n_actors]
+    )
+
+
+def _local_union_program(mesh: Mesh, n_actors: int):
+    key = ("local_union", mesh, n_actors)
+
+    def build():
+        def f(c, da):
+            u = _scatter_union(c, da, n_actors)
+            return jax.lax.pmax(jax.lax.pmax(u, "dp"), "sp")
+
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=(P("dp"), P("dp")),
+                out_specs=P(),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
 def local_clock_union(clock, doc_actors, n_actors: int, mesh: Mesh):
     """[D, A_loc] local-slot clocks + [D, A_loc] actor maps -> [n_actors]
-    global union. The scatter-max crosses dp shards, so XLA lowers the
-    replicated output to a max-allreduce over ICI."""
-    rep = NamedSharding(mesh, P())
-    fn = jax.jit(
-        lambda c, da: jnp.zeros(n_actors + 1, jnp.int32)
-        .at[jnp.where(da >= 0, da, n_actors).ravel()]
-        .max(jnp.where(da >= 0, c, 0).ravel())[:n_actors],
-        in_shardings=(doc_sharding(mesh), doc_sharding(mesh)),
-        out_shardings=rep,
-    )
+    global union. Each shard scatter-maxes its docs, then one explicit
+    pmax collective (shard_map) replicates the union over the mesh —
+    max-allreduce over ICI on hardware. The program is cached per
+    (mesh, n_actors): repeated calls never retrace."""
+    fn = _local_union_program(mesh, n_actors)
     with mesh:
         return fn(clock, doc_actors)
 
 
+def _step_program(mesh: Mesh, A: int, K: int, n_actors: int):
+    """ONE collective program for the full merge step: the per-shard
+    kernel, the per-shard scatter-max clock union, and the cross-shard
+    pmax — materialize + union in a single executable over the mesh."""
+    key = ("step", mesh, A, K, n_actors)
+
+    def build():
+        kern = batched_kernel(A, K)
+
+        def f(*args):
+            out = kern(*args)
+            u = _scatter_union(out.clock, args[-1], n_actors)
+            u = jax.lax.pmax(jax.lax.pmax(u, "dp"), "sp")
+            return out, u
+
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=(P("dp"),) * _N_ARGS,
+                out_specs=(
+                    MaterializeOut(
+                        *([P("dp")] * len(MaterializeOut._fields))
+                    ),
+                    P(),
+                ),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
+def step(batch: ColumnarBatch, mesh: Mesh):
+    """One full merge step: materialize everything + union every clock,
+    as ONE shard_map collective program over the mesh. This is the
+    framework's 'training step' analogue — the complete device-side
+    work of a bulk sync cycle."""
+    args, A, K, _ = shard_batch(batch, mesh)
+    n_actors = max(1, len(batch.actors))
+    fn = _step_program(mesh, A, K, n_actors)
+    with mesh:
+        return fn(*args)
+
+
+def _gather_program(mesh: Mesh, dtype, force_lax: bool = False):
+    """[rows, W] sharded over the flattened mesh axis -> replicated
+    [rows, W]: the bulk summary gather as one collective program. On
+    meshes whose chips pass `remote_copy_capable` the inner gather is a
+    Pallas `make_async_remote_copy` ring (sp == 1 ring topology);
+    everywhere else (CPU CI, sp > 1) it is `lax.all_gather` — identical
+    numerics, different transport. A Pallas failure can surface at
+    TRACE time (caught inside, falls back per-build) or at COMPILE
+    time (outside any try here — the caller retries with
+    `force_lax=True`, which keys a separate cached program)."""
+    n = mesh.devices.size
+    use_pallas = (
+        not force_lax
+        and remote_copy_capable(mesh)
+        and mesh.shape["sp"] == 1
+    )
+    key = ("gather", mesh, jnp.dtype(dtype).name, use_pallas)
+
+    def build():
+        def lax_gather(x):
+            g = jax.lax.all_gather(x, "sp", axis=0, tiled=True)
+            return jax.lax.all_gather(g, "dp", axis=0, tiled=True)
+
+        def pallas_gather(x):
+            try:
+                ring = _pallas_ring_gather(
+                    n, x.shape[0], x.shape[1], x.dtype
+                )
+                return ring(x)
+            except Exception:
+                # pallas TRACE failed for this shape/backend: the lax
+                # twin is always correct (compile-time failures are
+                # the caller's force_lax retry)
+                return lax_gather(x)
+
+        f = pallas_gather if use_pallas else lax_gather
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=P(("dp", "sp")),
+                out_specs=P(),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
+def _combine_partials_program(mesh: Mesh):
+    """[n_chips, A] (one row per chip, sharded over the flattened mesh
+    axis) -> replicated [A] max: the cross-chip clock-union combine."""
+    key = ("combine", mesh)
+
+    def build():
+        def f(x):
+            u = jnp.max(x, axis=0)
+            return jax.lax.pmax(jax.lax.pmax(u, "dp"), "sp")
+
+        return jax.jit(
+            shard_map(
+                _traced(key, f),
+                mesh=mesh,
+                in_specs=P(("dp", "sp")),
+                out_specs=P(),
+                check_rep=False,
+            )
+        )
+
+    return _program(key, build)
+
+
 class SlabRoundRobin:
-    """Round-robin WHOLE slabs across visible devices with bounded
+    """Stream WHOLE slabs across visible devices with bounded
     per-device in-flight queues — the streaming pipeline's multi-chip
     dispatch (RepoBackend._dispatch_slab under HM_PIPELINE=1).
 
@@ -225,14 +550,25 @@ class SlabRoundRobin:
     so results are bit-identical to the single-device and sharded
     paths.
 
+    Placement: strict round-robin by default; HM_RR_LEAST_LOADED=1 (or
+    least_loaded=True) picks the device with the SHORTEST in-flight
+    queue instead — a chip wedged on a slow slab is skipped while idle
+    chips take new work — with the round-robin cursor as the FIFO
+    tiebreak so equal loads still cycle.
+
     Backpressure: at most `depth` (HM_RR_DEPTH, default 2) unfetched
     slabs per device; dispatching onto a saturated device blocks on its
     OLDEST outstanding summary, which bounds host staging and device
-    memory to depth x n_devices slabs."""
+    memory to depth x n_devices slabs.
 
-    def __init__(self, devices=None, depth: int = None) -> None:
-        import os
+    Accounting: `t_dispatch_chip[i]` accumulates per-chip dispatch busy
+    seconds and `slabs_per_chip[i]` the slab count; `last_device` is the
+    index the most recent dispatch landed on (the bulk loader's per-chip
+    stats and the fetch stage's chip attribution read these)."""
 
+    def __init__(
+        self, devices=None, depth: int = None, least_loaded: bool = None
+    ) -> None:
         self.devices = list(
             devices if devices is not None else jax.devices()
         )
@@ -241,25 +577,70 @@ class SlabRoundRobin:
             if depth is not None
             else max(1, int(os.environ.get("HM_RR_DEPTH", "2")))
         )
+        self.least_loaded = (
+            least_loaded
+            if least_loaded is not None
+            else os.environ.get("HM_RR_LEAST_LOADED", "0") == "1"
+        )
         self._next = 0
         self._inflight = {i: [] for i in range(len(self.devices))}
+        self.t_dispatch_chip = [0.0] * len(self.devices)
+        self.slabs_per_chip = [0] * len(self.devices)
+        self.last_device: Optional[int] = None
+
+    def device_index(self, device) -> Optional[int]:
+        """Index of a jax device within this scheduler (None when it is
+        not one of ours) — the fetch stage attributes per-chip busy time
+        by the wire buffer's device."""
+        try:
+            return self.devices.index(device)
+        except ValueError:
+            return None
+
+    def _pick_device(self) -> int:
+        """Next device index. Round-robin: the cursor, regardless of
+        load (the dispatch below blocks if it is saturated). Least
+        loaded: the shortest in-flight queue, scanning from the cursor
+        so ties break FIFO — a saturated device is SKIPPED while any
+        other has room."""
+        n = len(self.devices)
+        if not self.least_loaded:
+            i = self._next
+            self._next = (self._next + 1) % n
+            return i
+        best = None
+        best_len = None
+        for k in range(n):
+            i = (self._next + k) % n
+            qlen = len(self._inflight[i])
+            if best_len is None or qlen < best_len:
+                best, best_len = i, qlen
+                if qlen == 0:
+                    break
+        self._next = (best + 1) % n
+        return best
 
     def dispatch(self, batch: ColumnarBatch, lean: bool = False):
-        """(MaterializeOut, summary wire) on the next device in the
-        cycle; blocks only when that device already holds `depth`
-        unfetched slabs. The kernel entry is run_batch_full with a
-        pinned device — the same code path as the single-device twin,
-        so the two cannot diverge."""
+        """(MaterializeOut, summary wire) on the chosen device; blocks
+        only when that device already holds `depth` unfetched slabs.
+        The kernel entry is run_batch_full with a pinned device — the
+        same code path as the single-device twin, so the two cannot
+        diverge."""
+        import time
+
         from ..ops.crdt_kernels import run_batch_full
 
-        i = self._next
-        self._next = (self._next + 1) % len(self.devices)
+        i = self._pick_device()
         q = self._inflight[i]
         while len(q) >= self.depth:
             q.pop(0).block_until_ready()
+        t0 = time.perf_counter()
         out, summary = run_batch_full(
             batch, lean=lean, device=self.devices[i]
         )
+        self.t_dispatch_chip[i] += time.perf_counter() - t0
+        self.slabs_per_chip[i] += 1
+        self.last_device = i
         q.append(summary)
         return out, summary
 
@@ -279,12 +660,215 @@ class SlabRoundRobin:
             q.clear()
 
 
-def step(batch: ColumnarBatch, mesh: Mesh):
-    """One full merge step: materialize everything + union every clock.
-    This is the framework's 'training step' analogue — the complete
-    device-side work of a bulk sync cycle."""
-    out, doc_actors = _materialize_on_mesh(batch, mesh)
-    union = local_clock_union(
-        out.clock, doc_actors, max(1, len(batch.actors)), mesh
-    )
-    return out, union
+class MeshBulkScheduler(SlabRoundRobin):
+    """SlabRoundRobin's streaming dispatch + shard_map collective
+    cross-doc reductions: the mesh-native bulk sync scheduler.
+
+    Dispatch is UNCHANGED from the round-robin parent (whole slabs
+    pinned per chip, host packs slab N+1 while chip k computes slab N,
+    identical kernels so summaries stay bit-identical) — but every
+    dispatched slab's device-resident outputs are also tracked per
+    chip, so the cross-doc reductions that used to be a host-side merge
+    of per-device fetches become collective programs over the mesh:
+
+    - `collective_clock_union(n_actors)`: each chip pre-reduces ITS
+      resident slabs' slot-local clocks (one tiny scatter-max program
+      per slab, executed where the data lives — no transfer), the
+      per-chip partials assemble zero-copy into one mesh-sharded
+      [n_chips, n_actors] array, and ONE shard_map pmax program
+      replicates the global union — a single [n_actors] fetch instead
+      of n_chips fetch-and-merge round trips.
+    - `gather_summaries()`: every chip's resident summary wires stack
+      on-chip, assemble into one mesh-sharded [rows, W] array, and ONE
+      collective gather program (`lax.all_gather`, or the Pallas
+      `make_async_remote_copy` ring on capable ICI) replicates them —
+      the host reads the whole load's summaries in ONE transfer, in
+      dispatch order.
+
+    Tracking is OPT-IN (`track_resident`): callers that will run the
+    collective reductions (the bulk-sync merge layer, the measured
+    bench, tests) pay the per-dispatch actor-map upload and keep
+    wire/clock refs pinned until `reset_resident()`; the PRODUCT bulk
+    loader constructs with tracking OFF — its barrier fetches per slab
+    on the overlapped fetch workers, so tracking there would pin every
+    slab's device wire for no consumer. Track + reduce state resets
+    with `reset_resident()` (a new bulk load) — the backpressure/
+    release contract is the parent's."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        depth: int = None,
+        least_loaded: bool = None,
+        track_resident: bool = True,
+    ) -> None:
+        super().__init__(
+            list(mesh.devices.flat), depth, least_loaded=least_loaded
+        )
+        self.mesh = mesh
+        self.track_resident = track_resident
+        # per chip: (clock ref [D, A_loc], doc_actors ref [D, A_loc])
+        self._resident_clocks: Dict[int, List] = {
+            i: [] for i in range(len(self.devices))
+        }
+        # per chip: (dispatch sequence number, n_docs, wire ref [D, W])
+        self._resident_wires: Dict[int, List] = {
+            i: [] for i in range(len(self.devices))
+        }
+        self._seq = 0
+
+    def reset_resident(self) -> None:
+        """Forget tracked device refs (start of a new bulk load)."""
+        for d in (self._resident_clocks, self._resident_wires):
+            for q in d.values():
+                q.clear()
+        self._seq = 0
+
+    def dispatch(self, batch: ColumnarBatch, lean: bool = False):
+        from ..ops.crdt_kernels import bucket_doc_actors
+
+        out, summary = super().dispatch(batch, lean=lean)
+        if not self.track_resident:
+            return out, summary
+        i = self.last_device
+        da, _A, _K = bucket_doc_actors(batch)
+        da_ref = jax.device_put(da, self.devices[i])
+        self._resident_clocks[i].append((out.clock, da_ref))
+        self._resident_wires[i].append(
+            (self._seq, batch.n_docs, summary)
+        )
+        self._seq += 1
+        return out, summary
+
+    # -- collective reductions over everything resident -----------------
+
+    def _chip_partial(self, items, n_actors: int, device):
+        """Max-fold one chip's resident (clock, da) refs into a [1,
+        n_actors] partial ON that chip. Data is committed to the chip,
+        so the cached scatter program executes there — no host hop."""
+        key = ("chip_union", n_actors)
+
+        def build():
+            def f(c, da, acc):
+                return jnp.maximum(acc, _scatter_union(c, da, n_actors))
+
+            return jax.jit(_traced(key, f))
+
+        fn = _program(key, build)
+        acc = jax.device_put(
+            jnp.zeros((n_actors,), jnp.int32), device
+        )
+        for clock, da in items:
+            acc = fn(clock, da, acc)
+        return acc.reshape(1, n_actors)
+
+    def collective_clock_union(self, n_actors: int):
+        """[n_actors] global union of every resident slab's clocks:
+        per-chip pre-reduce, then ONE shard_map pmax collective across
+        the mesh. Replaces fetching each chip's partial and merging on
+        host."""
+        import numpy as np
+
+        n_actors = max(1, n_actors)
+        partials = [
+            self._chip_partial(
+                self._resident_clocks[i], n_actors, self.devices[i]
+            )
+            for i in range(len(self.devices))
+        ]
+        sh = NamedSharding(self.mesh, P(("dp", "sp")))
+        arr = jax.make_array_from_single_device_arrays(
+            (len(self.devices), n_actors), sh, partials
+        )
+        fn = _combine_partials_program(self.mesh)
+        with self.mesh:
+            return np.asarray(fn(arr))
+
+    def gather_summaries(self):
+        """Every resident summary wire, host-side, in DISPATCH order:
+        [(seq, n_docs, np wire rows)] via ONE collective gather program
+        per wire width. Chips stack their wires locally (device-pinned
+        concat + zero-pad to the max per-chip row count), the stacks
+        assemble into one mesh-sharded array, and the gather collective
+        replicates it — a single device->host transfer serves the whole
+        load, replacing one fetch per slab per chip."""
+        import numpy as np
+
+        # group by wire width: one collective per distinct [.., W]
+        by_w: Dict[int, Dict[int, List]] = {}
+        for i, items in self._resident_wires.items():
+            for seq, n_docs, wire in items:
+                by_w.setdefault(wire.shape[1], {}).setdefault(
+                    i, []
+                ).append((seq, n_docs, wire))
+        out = []
+        for W, per_chip in sorted(by_w.items()):
+            rows_per_chip = [
+                sum(int(w.shape[0]) for _s, _n, w in per_chip.get(i, []))
+                for i in range(len(self.devices))
+            ]
+            rows = max(max(rows_per_chip), 1)
+            stacks = []
+            for i in range(len(self.devices)):
+                items = per_chip.get(i, [])
+                key = ("wire_stack", W, rows, len(items))
+
+                def build(items=items, rows=rows, W=W):
+                    def f(*wires):
+                        parts = list(wires) + [
+                            jnp.zeros(
+                                (
+                                    rows
+                                    - sum(
+                                        w.shape[0] for w in wires
+                                    ),
+                                    W,
+                                ),
+                                jnp.uint8,
+                            )
+                        ]
+                        return jnp.concatenate(parts, axis=0)
+
+                    return jax.jit(_traced(key, f))
+
+                fn = _program(key, build)
+                if items:
+                    stacks.append(fn(*[w for _s, _n, w in items]))
+                else:
+                    stacks.append(
+                        jax.device_put(
+                            jnp.zeros((rows, W), jnp.uint8),
+                            self.devices[i],
+                        )
+                    )
+            sh = NamedSharding(self.mesh, P(("dp", "sp")))
+            arr = jax.make_array_from_single_device_arrays(
+                (len(self.devices) * rows, W), sh, stacks
+            )
+            gfn = _gather_program(self.mesh, jnp.uint8)
+            try:
+                with self.mesh:
+                    host = np.asarray(gfn(arr))
+            except Exception:
+                # a Pallas ring that traced but failed to COMPILE (or
+                # execute) for this shape: retry on the lax-collective
+                # twin, which is always correct. Never retry a lax
+                # failure — that is a real error.
+                if not (
+                    remote_copy_capable(self.mesh)
+                    and self.mesh.shape["sp"] == 1
+                ):
+                    raise
+                gfn = _gather_program(
+                    self.mesh, jnp.uint8, force_lax=True
+                )
+                with self.mesh:
+                    host = np.asarray(gfn(arr))
+            for i in range(len(self.devices)):
+                base = i * rows
+                for seq, n_docs, wire in per_chip.get(i, []):
+                    n = int(wire.shape[0])
+                    out.append((seq, n_docs, host[base : base + n]))
+                    base += n
+        out.sort(key=lambda t: t[0])
+        return out

@@ -1,0 +1,122 @@
+"""Profile the MULTI-CHIP cold-start product path: make_corpus ->
+open_many streamed across the device mesh, with a per-chip
+busy-vs-wall timeline (the mesh twin of profile_cold.py).
+
+Usage: [PROF_DOCS=2048] [PROF_OPS=512] [PROF_SLAB=512] \
+       JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/profile_mesh.py
+
+Needs >1 visible device (the virtual CPU mesh flag above, or real
+chips). Prints the stage timeline, then per-chip slab placement and
+dispatch/fetch busy bars — the load-balance view that tells you whether
+the wall clock is bounded by slab IO (good) or by one hot chip (bad).
+"""
+
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+n_docs = int(os.environ.get("PROF_DOCS", "2048"))
+n_ops = int(os.environ.get("PROF_OPS", "512"))
+slab = int(os.environ.get("PROF_SLAB", "512"))
+
+import jax  # noqa: E402
+
+from hypermerge_tpu.ops.corpus import make_corpus  # noqa: E402
+from hypermerge_tpu.parallel.mesh import device_topology  # noqa: E402
+from hypermerge_tpu.repo import Repo  # noqa: E402
+from hypermerge_tpu.utils.ids import validate_doc_url  # noqa: E402
+
+topo = device_topology()
+print(f"topology: {topo}")
+if topo["n_devices"] < 2:
+    sys.exit("needs >1 device (set --xla_force_host_platform_device_count)")
+
+tmp = tempfile.mkdtemp(prefix="hmprofmesh")
+t0 = time.perf_counter()
+urls = make_corpus(tmp, n_docs, n_ops)
+print(
+    f"corpus: {n_docs} docs x {n_ops} ops in "
+    f"{time.perf_counter() - t0:.2f}s"
+)
+
+t0 = time.perf_counter()
+repo = Repo(path=tmp)
+print(f"repo ctor: {time.perf_counter() - t0:.2f}s")
+
+t0 = time.perf_counter()
+ids = [validate_doc_url(u) for u in urls]
+repo.back.load_documents_bulk(ids, slab=slab)
+summaries = repo.back.fetch_bulk_summaries()  # the honest barrier
+wall = time.perf_counter() - t0
+stats = dict(repo.back.last_bulk_stats)
+print(
+    f"open_many+summaries: {wall:.2f}s -> "
+    f"{n_docs * n_ops / wall:,.0f} ops/s "
+    f"({len(summaries.doc_ids)} summarized)"
+)
+
+
+def _bar(v, scale):
+    return "#" * max(1, int(40 * v / max(scale, 1e-9))) if v else ""
+
+
+# stage timeline (same view as profile_cold.py)
+keys = (
+    "t_sql", "t_io", "t_spec", "t_pack", "t_narrow", "t_upload",
+    "t_dispatch", "t_fetch_busy",
+)
+print("stage timeline [busy (overlapped)]:")
+busy_total = 0.0
+for k in keys:
+    v = stats.get(k) or 0.0
+    if not v:
+        continue
+    busy_total += v
+    print(f"  {k:<13} {v:7.3f}s |{_bar(v, wall)}")
+cp = stats.get("wall_critical_path", wall)
+print(
+    f"  wall critical path {cp:.3f}s, stage busy total "
+    f"{busy_total:.3f}s -> {busy_total / max(cp, 1e-9):.2f}x concurrency"
+)
+
+# per-chip placement + busy timeline: the mesh load-balance view
+slabs = stats.get("slabs_per_chip") or []
+disp = stats.get("t_dispatch_chips") or []
+fetch = stats.get("t_fetch_chips") or [0.0] * len(disp)
+if not disp:
+    print(
+        "no per-chip stats (load below HM_DEVICE_MIN_CELLS, or a "
+        "single-device path) — nothing dispatched to the mesh"
+    )
+else:
+    scale = max(max(disp, default=0.0), max(fetch, default=0.0))
+    print(f"per-chip timeline ({stats.get('rr_slabs', 0)} slab(s)):")
+    for i in range(len(disp)):
+        print(
+            f"  chip {i}: {slabs[i] if i < len(slabs) else 0} slab(s)  "
+            f"dispatch {disp[i]:7.3f}s |{_bar(disp[i], scale):<40}| "
+            f"fetch {fetch[i] if i < len(fetch) else 0.0:7.3f}s "
+            f"|{_bar(fetch[i] if i < len(fetch) else 0.0, scale)}"
+        )
+    busiest = max(disp)
+    ideal = sum(disp) / len(disp)
+    print(
+        f"  balance: busiest chip {busiest:.3f}s vs ideal "
+        f"{ideal:.3f}s ({busiest / max(ideal, 1e-9):.2f}x skew)"
+    )
+
+repo.close()
+import shutil  # noqa: E402
+
+shutil.rmtree(tmp, ignore_errors=True)

@@ -10,9 +10,14 @@ import pytest
 from hypermerge_tpu.ops.crdt_kernels import run_batch
 from hypermerge_tpu.ops.synth import synth_batch, synth_changes
 from hypermerge_tpu.parallel.mesh import make_mesh
+from hypermerge_tpu.parallel import sharded as sharded_mod
 from hypermerge_tpu.parallel.sharded import (
+    MeshBulkScheduler,
+    SlabRoundRobin,
+    local_clock_union,
     sharded_clock_union,
     sharded_dominated,
+    sharded_full,
     sharded_materialize,
     step,
 )
@@ -107,6 +112,209 @@ def test_synth_columns_equal_synth_changes_on_device():
 
 def _run(batch):
     return batch, run_batch(batch)
+
+
+# -- mesh shapes the fuzz matrix pins: (dp, sp) ------------------------
+_MESH_SHAPES = [(8, 1), (4, 2), (2, 2), (1, 1)]
+
+
+def _mesh_for(dp, sp):
+    return make_mesh(dp * sp, sp=sp)
+
+
+def _host_local_union(clock, doc_actors, n_actors):
+    """Numpy twin of the collective local clock union."""
+    want = np.zeros(n_actors + 1, np.int64)
+    c = np.asarray(clock)
+    da = np.asarray(doc_actors)
+    np.maximum.at(
+        want,
+        np.where(da >= 0, da, n_actors).ravel(),
+        np.where(da >= 0, c, 0).ravel(),
+    )
+    return want[:n_actors].astype(np.int32)
+
+
+def test_mesh_reductions_fuzz_bit_identical_across_shapes():
+    """sharded_clock_union / sharded_dominated match the numpy twin on
+    every mesh shape, including ragged (non-multiple) doc and actor
+    counts that force padding on both axes."""
+    rng = np.random.default_rng(7)
+    for dp, sp in _MESH_SHAPES:
+        mesh = _mesh_for(dp, sp)
+        for D, A in [(13, 5), (32, 16), (7, 11), (1, 1), (64, 3)]:
+            clocks = rng.integers(0, 1000, (D, A)).astype(np.int32)
+            union = np.asarray(sharded_clock_union(clocks, mesh))
+            np.testing.assert_array_equal(
+                union, clocks.max(axis=0), err_msg=f"{dp}x{sp} {D}x{A}"
+            )
+            query = clocks[rng.integers(0, D)]
+            dom = np.asarray(sharded_dominated(clocks, query, mesh))
+            np.testing.assert_array_equal(
+                dom,
+                np.all(clocks <= query, axis=-1),
+                err_msg=f"{dp}x{sp} {D}x{A}",
+            )
+
+
+def test_step_fuzz_bit_identical_to_single_device_across_shapes():
+    """The one-program collective merge step (materialize + clock
+    union) matches the single-device twin on every mesh shape, ragged
+    doc counts included."""
+    from hypermerge_tpu.ops.crdt_kernels import bucket_doc_actors
+
+    for seed, (dp, sp) in enumerate(_MESH_SHAPES):
+        mesh = _mesh_for(dp, sp)
+        for n_docs in (13, 8):
+            batch = synth_batch(n_docs=n_docs, n_ops=96, seed=seed)
+            single = run_batch(batch)
+            da, _A, _K = bucket_doc_actors(batch)
+            n_actors = len(batch.actors)
+            out, union = step(batch, mesh)
+            for field in (
+                "visible", "map_winner", "elem_live", "rank", "clock",
+            ):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(single, field)),
+                    np.asarray(getattr(out, field))[:n_docs],
+                    err_msg=f"{dp}x{sp} D={n_docs} {field}",
+                )
+            np.testing.assert_array_equal(
+                np.asarray(union),
+                _host_local_union(single.clock, da, n_actors),
+                err_msg=f"{dp}x{sp} D={n_docs} union",
+            )
+
+
+def test_mesh_programs_cached_no_retrace():
+    """Repeated same-shape calls reuse ONE traced program: the program
+    table (not a fresh jit closure per call) serves local_clock_union,
+    sharded_full, and step — the r5 per-call retrace regression."""
+    mesh = make_mesh(8, sp=1)
+    batch = synth_batch(n_docs=16, n_ops=64, seed=1)
+    n_actors = max(1, len(batch.actors))
+
+    out, da = sharded_mod._materialize_on_mesh(batch, mesh)
+    local_clock_union(out.clock, da, n_actors, mesh)
+    sharded_full(batch, mesh, lean=False)
+    step(batch, mesh)
+    sharded_clock_union(
+        np.ones((16, 8), np.int32), mesh
+    )
+    snapshot = dict(sharded_mod.trace_counts)
+    assert snapshot, "trace counter never engaged"
+
+    for _ in range(3):
+        out, da = sharded_mod._materialize_on_mesh(batch, mesh)
+        local_clock_union(out.clock, da, n_actors, mesh)
+        sharded_full(batch, mesh, lean=False)
+        step(batch, mesh)
+        sharded_clock_union(np.ones((16, 8), np.int32), mesh)
+    assert dict(sharded_mod.trace_counts) == snapshot, (
+        "a mesh program retraced on a repeated same-shape call",
+        snapshot,
+        sharded_mod.trace_counts,
+    )
+
+
+class _Saturator:
+    """Sentinel in-flight entry: popping it (blocking on a saturated
+    device) is the failure the least-loaded test pins against."""
+
+    def block_until_ready(self):
+        raise AssertionError(
+            "dispatch blocked on the saturated device instead of "
+            "skipping to an idle one"
+        )
+
+
+def test_least_loaded_skips_saturated_device():
+    """HM_RR_LEAST_LOADED: a device at its in-flight depth is skipped
+    while any other device has room (FIFO tiebreak otherwise)."""
+    from hypermerge_tpu.ops.columnar import pack_docs
+
+    devices = jax.devices()
+    rr = SlabRoundRobin(devices, depth=2, least_loaded=True)
+    # saturate device 0 (the round-robin cursor's first pick)
+    rr._inflight[0] = [_Saturator(), _Saturator()]
+    batch = pack_docs(
+        [synth_changes(48, n_actors=1, ops_per_change=8, seed=0)]
+    )
+    _out, wire = rr.dispatch(batch, lean=False)
+    assert rr.last_device == 1  # skipped 0, FIFO tiebreak picked 1
+    assert next(iter(wire.devices())) == devices[1]
+    assert len(rr._inflight[0]) == 2  # untouched
+    # strict round-robin twin WOULD have blocked (and popped) device 0
+    rr_strict = SlabRoundRobin(devices, depth=2, least_loaded=False)
+    rr_strict._inflight[0] = [_Saturator(), _Saturator()]
+    with pytest.raises(AssertionError, match="saturated"):
+        rr_strict.dispatch(batch, lean=False)
+
+
+def test_least_loaded_env_gate(monkeypatch):
+    monkeypatch.setenv("HM_RR_LEAST_LOADED", "1")
+    assert SlabRoundRobin(jax.devices()).least_loaded
+    monkeypatch.setenv("HM_RR_LEAST_LOADED", "0")
+    assert not SlabRoundRobin(jax.devices()).least_loaded
+
+
+def test_mesh_scheduler_collective_union_and_gather():
+    """MeshBulkScheduler: streaming whole-slab dispatch stays
+    bit-identical to per-slab fetch, while the cross-doc reductions
+    (clock union, summary gather) run as collective programs whose
+    results equal the host-side merge they replace."""
+    from hypermerge_tpu.ops.columnar import pack_docs
+    from hypermerge_tpu.ops.crdt_kernels import bucket_doc_actors
+    from hypermerge_tpu.ops.materialize import fetch_summary
+
+    mesh = make_mesh(8, sp=2)
+    sch = MeshBulkScheduler(mesh, depth=2)
+    batches = [
+        pack_docs(
+            [synth_changes(48, n_actors=2, ops_per_change=8, seed=s)]
+        )
+        for s in range(5)
+    ]
+    outs = []
+    for b in batches:
+        out, wire = sch.dispatch(b, lean=False)
+        outs.append((b, out, wire))
+    n_actors = max(len(b.actors) for b in batches)
+    want = np.zeros(n_actors, np.int32)
+    for b, out, _w in outs:
+        da, _A, _K = bucket_doc_actors(b)
+        want = np.maximum(
+            want, _host_local_union(out.clock, da, n_actors)
+        )
+    np.testing.assert_array_equal(
+        sch.collective_clock_union(n_actors), want
+    )
+    gathered = sch.gather_summaries()
+    assert [g[0] for g in gathered] == list(range(len(batches)))
+    for (_seq, _n, host_wire), (b, _out, wire) in zip(gathered, outs):
+        np.testing.assert_array_equal(host_wire, np.asarray(wire))
+        a = fetch_summary(host_wire, b, lean=False)
+        bsl = fetch_summary(wire, b, lean=False)
+        for k in a:
+            np.testing.assert_array_equal(a[k], bsl[k], err_msg=k)
+    # per-chip accounting: every dispatched slab is attributed
+    assert sum(sch.slabs_per_chip) == len(batches)
+    sch.drain()
+    sch.release()
+    sch.reset_resident()
+    assert sch.gather_summaries() == []
+
+
+def test_remote_copy_capability_gate(monkeypatch):
+    """CPU host-platform meshes never select the Pallas ICI path; the
+    env escape hatch forces it off everywhere."""
+    from hypermerge_tpu.parallel.sharded import remote_copy_capable
+
+    mesh = make_mesh(8, sp=1)
+    assert remote_copy_capable(mesh) is False  # cpu devices
+    assert remote_copy_capable() is False
+    monkeypatch.setenv("HM_ICI_PALLAS", "0")
+    assert remote_copy_capable(mesh) is False
 
 
 def test_graft_entry_single_chip():

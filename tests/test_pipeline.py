@@ -349,6 +349,44 @@ def test_slab_round_robin_cycles_and_bounds_inflight():
         assert int(np.asarray(arrays["n_map_entries"][0])) >= 0
 
 
+def test_pipeline_per_chip_stats(tmp_path, monkeypatch):
+    """Mesh-aware stats: the pipelined bulk load reports per-chip
+    dispatch/fetch busy times and slab placement alongside the stage
+    totals."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >1 (virtual) device")
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")
+    urls, want = _make_corpus(tmp_path / "r", n_docs=6, seed=7)
+    repo = Repo(path=str(tmp_path / "r"))
+    ids = [validate_doc_url(u) for u in urls]
+    repo.back.load_documents_bulk(ids, slab=2)
+    repo.back.fetch_bulk_summaries()
+    stats = repo.back.last_bulk_stats
+    n = len(jax.devices())
+    assert len(stats["t_dispatch_chips"]) == n, stats
+    assert len(stats["slabs_per_chip"]) == n
+    assert sum(stats["slabs_per_chip"]) == stats["rr_slabs"] == 3
+    # every dispatched slab's busy time is attributed to its chip
+    assert sum(
+        1 for t in stats["t_dispatch_chips"] if t > 0
+    ) == sum(1 for s in stats["slabs_per_chip"] if s > 0)
+    assert len(stats.get("t_fetch_chips", [])) == n, stats
+    assert sum(stats["t_fetch_chips"]) > 0
+    # the PRODUCT scheduler never tracks collective-reduction refs:
+    # nothing may pin slab wires beyond the barrier
+    rr = repo.back._rr_value
+    if hasattr(rr, "track_resident"):
+        assert rr.track_resident is False
+        assert all(not q for q in rr._resident_wires.values())
+        assert all(not q for q in rr._resident_clocks.values())
+    for u in urls:
+        assert plainify(repo.doc(u)) == want[u]
+    repo.close()
+
+
 def test_pipeline_stats_report_busy_and_critical_path(tmp_path, monkeypatch):
     """Pipeline mode reports per-stage busy time (t_*_busy) and the
     overlapped wall critical path alongside the canonical keys."""

@@ -4,10 +4,17 @@ tools/Meta.ts — `repo.meta(url, cb)` surfaced on the command line).
 
     python tools/meta.py /path/to/repo 'hypermerge:/<docId>'
     python tools/meta.py /path/to/repo 'hyperfile:/<fileId>'
+    python tools/meta.py --devices
 
 Output is one JSON object. Documents are opened first (metadata queries
 answer from the open doc's backend state); unknown urls print null and
 exit non-zero.
+
+`--devices` prints the visible-device/mesh topology instead (no repo
+needed): device count, platform/kind, (dp, sp) mesh shape, and whether
+the Pallas ICI remote-copy path is live — the same object the bench
+embeds as `multichip_topology`, so a bench JSON line is auditable
+against the box it ran on.
 """
 
 import argparse
@@ -24,13 +31,28 @@ from hypermerge_tpu.utils.ids import is_doc_url  # noqa: E402
 
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("repo", help="repo directory")
-    ap.add_argument("url", help="hypermerge:/ doc url or hyperfile:/ url")
+    ap.add_argument("repo", nargs="?", help="repo directory")
+    ap.add_argument(
+        "url", nargs="?",
+        help="hypermerge:/ doc url or hyperfile:/ url",
+    )
     ap.add_argument(
         "--timeout", type=float, default=30.0,
         help="seconds to wait for the doc to come up (default 30)",
     )
+    ap.add_argument(
+        "--devices", action="store_true",
+        help="print visible device / mesh topology JSON and exit",
+    )
     args = ap.parse_args()
+
+    if args.devices:
+        from hypermerge_tpu.parallel.mesh import device_topology
+
+        print(json.dumps(device_topology(), sort_keys=True), flush=True)
+        return
+    if args.repo is None or args.url is None:
+        ap.error("repo and url are required (or use --devices)")
 
     repo = Repo(path=args.repo)
     try:
