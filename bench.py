@@ -1223,9 +1223,25 @@ def main() -> None:
                     # the measured number
                     "projection_8chip_reference_s": round(proj8, 2),
                 },
+                # round-13 observability: the process-wide registry
+                # snapshot for THIS bench run (every subsystem's
+                # counters in one block) + trace state. A NEW key —
+                # every pre-existing key above is untouched.
+                "telemetry": _telemetry_block(),
             }
         )
     )
+
+
+def _telemetry_block():
+    from hypermerge_tpu import telemetry
+
+    return {
+        "counters": telemetry.snapshot(),
+        "tracing": telemetry.tracing_enabled(),
+        "trace_spans": telemetry.event_count(),
+        "trace_file": telemetry.trace_path(),
+    }
 
 
 if __name__ == "__main__":

@@ -69,8 +69,26 @@ from ..crdt.patch import Conflict, Diff, Patch
 from ..ops.columnar import LiveColumns
 from ..utils.debounce import Debouncer
 from ..utils.debug import log
+from .. import telemetry
 
 ROOT_ID = "0@_root"
+
+# engine stats series (telemetry registry, labeled per engine). The
+# key lists drive both the handle table and the `stats` property, so
+# the dict shape bench.py/tests read stays exactly the pre-telemetry
+# one: event counts first, then the resident gauges, then seconds.
+_LIVE_COUNTS = (
+    "adopted", "refused", "ticks", "tick_docs", "tick_changes",
+    "inc_changes", "kernel_runs", "device_dispatches",
+    "local_changes", "adopt_retries", "demoted", "readopted",
+)
+_LIVE_GAUGES = ("live_bytes", "live_docs")
+_LIVE_TIMES = (
+    "t_live_append", "t_live_apply", "t_live_kernel",
+    "t_live_decode", "t_live_diff",
+    "t_adopt_pack", "t_adopt_kernel", "t_adopt_decode",
+    "t_adopt_reach", "t_adopt_lock_free", "t_adopt_lock_held",
+)
 
 
 def _tick_window_s() -> float:
@@ -635,19 +653,19 @@ class LiveApplyEngine:
         self._adopting: Dict[str, _AdoptGate] = {}
         self._demoted_ids: Set[str] = set()  # for the readopted stat
         self._use_clock = 0  # monotone LRU counter (engine lock)
-        self.stats: Dict[str, Any] = {
-            "adopted": 0, "refused": 0, "ticks": 0, "tick_docs": 0,
-            "tick_changes": 0, "inc_changes": 0, "kernel_runs": 0,
-            "device_dispatches": 0, "local_changes": 0,
-            "adopt_retries": 0, "demoted": 0, "readopted": 0,
-            "live_bytes": 0, "live_docs": 0,
-            "t_live_append": 0.0, "t_live_apply": 0.0,
-            "t_live_kernel": 0.0, "t_live_decode": 0.0,
-            "t_live_diff": 0.0,
-            "t_adopt_pack": 0.0, "t_adopt_kernel": 0.0,
-            "t_adopt_decode": 0.0, "t_adopt_reach": 0.0,
-            "t_adopt_lock_free": 0.0, "t_adopt_lock_held": 0.0,
+        # stats live on the PROCESS telemetry registry (ISSUE 9): one
+        # labeled series per engine so concurrent repos stay exact,
+        # per-thread sharded adds so no bump needs the engine lock,
+        # and the `stats` property rebuilds the historical dict shape
+        # bench.py and the tests read.
+        inst = str(telemetry.next_instance())
+        reg = telemetry.REGISTRY
+        self._m: Dict[str, Any] = {
+            k: reg.counter("live." + k, inst=inst)
+            for k in _LIVE_COUNTS + _LIVE_TIMES
         }
+        for k in _LIVE_GAUGES:
+            self._m[k] = reg.gauge("live." + k, inst=inst)
         self._ticker = Debouncer(
             self._on_tick,
             window_s=_tick_window_s(),
@@ -664,6 +682,20 @@ class LiveApplyEngine:
     def emission_lock(self) -> threading.RLock:
         """The lock host-path emissions must hold (see __init__)."""
         return self._lock
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        """The engine's stats as the historical dict (registry-backed;
+        read-only — a write to the returned dict mutates a copy)."""
+        m = self._m
+        out: Dict[str, Any] = {}
+        for k in _LIVE_COUNTS:
+            out[k] = int(m[k].value())
+        for k in _LIVE_GAUGES:
+            out[k] = int(m[k].value())
+        for k in _LIVE_TIMES:
+            out[k] = round(m[k].value(), 6)
+        return out
 
     # ------------------------------------------------------------------
     # seams (called by DocBackend)
@@ -725,7 +757,7 @@ class LiveApplyEngine:
                     )
                 change, patch = self._apply_local_locked(ld, req)
                 self._sync_doc_meta(ld)
-                self.stats["local_changes"] += 1
+                self._m["local_changes"].add(1)
                 if emit is not None:
                     emit(change, patch)
             return change, patch
@@ -795,6 +827,10 @@ class LiveApplyEngine:
 
     def close(self) -> None:
         self._ticker.close()
+        # fold this engine's labeled series into the closed aggregate:
+        # repos open/close freely without growing the registry a label
+        # set per lifecycle (stats stays readable — it is handle-based)
+        telemetry.REGISTRY.retire(*self._m.values())
 
     # ------------------------------------------------------------------
     # adoption (lock-free build + install-and-recheck)
@@ -852,7 +888,8 @@ class LiveApplyEngine:
         ld = None
         now = time.perf_counter
         t0 = now()
-        held0 = self.stats["t_adopt_lock_held"]
+        held0 = self._m["t_adopt_lock_held"].value()
+        sp = telemetry.begin("live.adopt", cat="live")
         try:
             for _attempt in range(3):
                 built = self._adopt_build(doc)
@@ -862,18 +899,18 @@ class LiveApplyEngine:
                 if status == "retry":
                     # serving clock moved during the build (a host-path
                     # emission raced in): discard and rebuild
-                    with self._lock:
-                        self.stats["adopt_retries"] += 1
+                    self._m["adopt_retries"].add(1)
                     continue
                 outcome = status
                 break
         finally:
+            sp.end(outcome=outcome)
             with self._lock:
                 self._adopting.pop(doc.id, None)
                 gate.outcome = outcome
                 if outcome == "refused":
                     self._refused.add(doc.id)
-                    self.stats["refused"] += 1
+                    self._m["refused"].add(1)
                     # doc._live stays SET: _emission_lock must keep
                     # returning the engine lock for this doc's host-path
                     # emissions, or a refused doc's patches and its
@@ -884,11 +921,9 @@ class LiveApplyEngine:
                     # and _refused rejects re-adoption.
                 # the install window is lock-HELD: keep the two stats
                 # disjoint so lock_free + lock_held = build wall
-                self.stats["t_adopt_lock_free"] = round(
-                    self.stats["t_adopt_lock_free"]
-                    + (now() - t0)
-                    - (self.stats["t_adopt_lock_held"] - held0),
-                    6,
+                self._m["t_adopt_lock_free"].add(
+                    (now() - t0)
+                    - (self._m["t_adopt_lock_held"].value() - held0)
                 )
             gate.event.set()
         return ld if outcome == "ok" else None
@@ -934,12 +969,12 @@ class LiveApplyEngine:
             state.reachable = _reachable_from_lanes(lv, lanes)
             t4 = now()  # inside the pause: the deferred gen0 sweep at
             # re-enable charges the build total, not the reach stage
-        with self._lock:
-            s = self.stats
-            s["t_adopt_pack"] = round(s["t_adopt_pack"] + t1 - t0, 6)
-            s["t_adopt_kernel"] = round(s["t_adopt_kernel"] + t2 - t1, 6)
-            s["t_adopt_decode"] = round(s["t_adopt_decode"] + t3 - t2, 6)
-            s["t_adopt_reach"] = round(s["t_adopt_reach"] + t4 - t3, 6)
+        # sharded counters: no engine lock needed for stats anymore
+        m = self._m
+        m["t_adopt_pack"].add(t1 - t0)
+        m["t_adopt_kernel"].add(t2 - t1)
+        m["t_adopt_decode"].add(t3 - t2)
+        m["t_adopt_reach"].add(t4 - t3)
         ld = _LiveDoc(
             doc, lv, state, clock,
             int(batch.cols["ctr"][0].max(initial=0)), history_len,
@@ -965,14 +1000,12 @@ class LiveApplyEngine:
                 doc._live_adopted = True
             ld.last_use = self._bump_use()
             self._docs[doc.id] = ld
-            self.stats["adopted"] += 1
+            self._m["adopted"].add(1)
             if doc.id in self._demoted_ids:
                 self._demoted_ids.discard(doc.id)
-                self.stats["readopted"] += 1
+                self._m["readopted"].add(1)
             self._enforce_budget_locked()
-            self.stats["t_adopt_lock_held"] = round(
-                self.stats["t_adopt_lock_held"] + now() - t0, 6
-            )
+            self._m["t_adopt_lock_held"].add(now() - t0)
         return "ok", ld
 
     # ------------------------------------------------------------------
@@ -989,7 +1022,7 @@ class LiveApplyEngine:
         their tick. Caller holds the engine lock."""
         cap = _live_max_bytes()
         if cap <= 0:
-            self.stats["live_docs"] = len(self._docs)
+            self._m["live_docs"].set(len(self._docs))
             return
         self._demote_pass(cap, protect_mru=True)
 
@@ -1017,7 +1050,7 @@ class LiveApplyEngine:
         docs = self._docs
         sizes = {i: ld.resident_bytes() for i, ld in docs.items()}
         total = sum(sizes.values())
-        n0 = self.stats["demoted"]
+        n0 = self._m["demoted"].value()
         if docs and total > cap:
             mru = (
                 max(docs.values(), key=lambda l: l.last_use)
@@ -1033,9 +1066,9 @@ class LiveApplyEngine:
                     continue
                 self._demote_locked(ld)
                 total -= sizes[ld.doc.id]
-        self.stats["live_bytes"] = total
-        self.stats["live_docs"] = len(docs)
-        return self.stats["demoted"] - n0
+        self._m["live_bytes"].set(total)
+        self._m["live_docs"].set(len(docs))
+        return int(self._m["demoted"].value() - n0)
 
     def _demotable(self, ld: _LiveDoc) -> bool:
         """Re-adoption must be able to rebuild this exact state from
@@ -1071,11 +1104,12 @@ class LiveApplyEngine:
         holds the engine lock."""
         doc = ld.doc
         log("live", f"demoting {doc.id[:6]} to lazy (LRU)")
+        telemetry.instant("live.demote", cat="live")
         snap = self._back._demoted_snapshot_fn(doc.id, dict(ld.clock))
         doc.demote_from_live(dict(ld.clock), ld.history_len, snap)
         self._docs.pop(doc.id, None)
         self._demoted_ids.add(doc.id)
-        self.stats["demoted"] += 1
+        self._m["demoted"].add(1)
 
     @staticmethod
     def _ranges_ok(lv: LiveColumns) -> bool:
@@ -1126,9 +1160,10 @@ class LiveApplyEngine:
     # the tick
 
     def _on_tick(self, marked: Dict) -> None:
-        with self._lock:
-            self._flush_ids(list(marked))
-            self._enforce_budget_locked()
+        with telemetry.span("live.tick", cat="live"):
+            with self._lock:
+                self._flush_ids(list(marked))
+                self._enforce_budget_locked()
 
     def _flush_ids(self, doc_ids: List[str]) -> None:
         """Apply every queued change of the named docs; emit one delta
@@ -1147,24 +1182,22 @@ class LiveApplyEngine:
         ]
         if not dirty:
             return
-        stats = self.stats
+        m = self._m
         t0 = now()
         batches = []
         for ld in dirty:
             ld.last_use = self._bump_use()
             changes = ld.queued
             ld.queued = []
-            stats["tick_changes"] += len(changes)
+            m["tick_changes"].add(len(changes))
             ld.cols.append_changes(changes)
             if not self._ranges_ok(ld.cols):
                 self._evict_to_host(ld)
                 continue
             batches.append((ld, changes))
-        stats["t_live_append"] = round(
-            stats["t_live_append"] + now() - t0, 6
-        )
-        stats["ticks"] += 1
-        stats["tick_docs"] += len(batches)
+        m["t_live_append"].add(now() - t0)
+        m["ticks"].add(1)
+        m["tick_docs"].add(len(batches))
 
         budget = _inc_budget_cells()
         kernel_docs: List[_LiveDoc] = []
@@ -1178,10 +1211,8 @@ class LiveApplyEngine:
             for c in changes:
                 for i, op in enumerate(c.ops):
                     self._apply_op_state(ld.state, c.op_id(i), op, diffs)
-            stats["inc_changes"] += len(changes)
-            stats["t_live_apply"] = round(
-                stats["t_live_apply"] + now() - t1, 6
-            )
+            m["inc_changes"].add(len(changes))
+            m["t_live_apply"].add(now() - t1)
             self._emit_tick(ld, diffs)
         if not kernel_docs:
             return
@@ -1215,12 +1246,10 @@ class LiveApplyEngine:
 
     def _run_group(self, bucket_n: int, lds: List[_LiveDoc]) -> None:
         now = time.perf_counter
-        stats = self.stats
+        m = self._m
         t0 = now()
         lanes_by_doc = self._kernel(bucket_n, lds)
-        stats["t_live_kernel"] = round(
-            stats["t_live_kernel"] + now() - t0, 6
-        )
+        m["t_live_kernel"].add(now() - t0)
         for ld, lanes in zip(lds, lanes_by_doc):
             t1 = now()
             with _gc_paused():
@@ -1228,12 +1257,8 @@ class LiveApplyEngine:
             t2 = now()
             diffs = _diff_states(ld.state, new_state)
             ld.state = new_state
-            stats["t_live_decode"] = round(
-                stats["t_live_decode"] + t2 - t1, 6
-            )
-            stats["t_live_diff"] = round(
-                stats["t_live_diff"] + now() - t2, 6
-            )
+            m["t_live_decode"].add(t2 - t1)
+            m["t_live_diff"].add(now() - t2)
             self._emit_tick(ld, diffs)
 
     def _kernel(self, bucket_n: int, lds: List[_LiveDoc]):
@@ -1243,7 +1268,7 @@ class LiveApplyEngine:
         fuzz reference)."""
         D = len(lds)
         if D * bucket_n < _device_min_cells():
-            self.stats["kernel_runs"] += 1
+            self._m["kernel_runs"].add(1)
             return [self._host_lanes(ld.cols) for ld in lds]
         return self._kernel_device(bucket_n, lds)
 
@@ -1274,8 +1299,8 @@ class LiveApplyEngine:
             materialize_live_device,
         )
 
-        self.stats["kernel_runs"] += 1
-        self.stats["device_dispatches"] += 1
+        self._m["kernel_runs"].add(1)
+        self._m["device_dispatches"].add(1)
         D = live_bucket(len(lds), LIVE_MIN_DOCS)
         N = bucket_n
         A = live_bucket(

@@ -47,7 +47,21 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, List, Optional, Tuple
+
+from .. import telemetry
+
+# process-wide pipeline series (telemetry registry): cumulative stage
+# busy seconds + slab counts across every bulk load, and live queue
+# depth gauges — the "what is the cold open doing RIGHT NOW" view
+# tools/top.py renders. last_bulk_stats stays the per-load truth
+# bench.py scrapes; these are the daemon-lifetime aggregate.
+_M_SLABS = telemetry.counter("pipeline.slabs")
+_M_BUSY = {
+    stage: telemetry.counter(f"pipeline.{stage}_busy_s")
+    for stage in ("io", "pack", "dispatch", "fetch")
+}
 
 
 class PipelineError(RuntimeError):
@@ -146,6 +160,14 @@ class SlabPipeline:
         self.pack_q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.disp_q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.fetch_q: "queue.Queue" = queue.Queue(maxsize=2 * depth)
+        # live queue-depth gauges (one table per seam, process-wide:
+        # concurrent loads share the gauges — last writer wins, which
+        # is the right answer for a "now" view)
+        self._q_gauges = {
+            id(self.pack_q): telemetry.gauge("pipeline.q_pack"),
+            id(self.disp_q): telemetry.gauge("pipeline.q_dispatch"),
+            id(self.fetch_q): telemetry.gauge("pipeline.q_fetch"),
+        }
         self.abort = threading.Event()
         self.error: Optional[BaseException] = None
         self.error_stage: Optional[str] = None
@@ -162,6 +184,7 @@ class SlabPipeline:
                 raise _Abort()
             try:
                 q.put(item, timeout=_POLL_S)
+                self._q_gauges[id(q)].set(q.qsize())
                 return
             except queue.Full:
                 continue
@@ -171,7 +194,9 @@ class SlabPipeline:
             if self.abort.is_set():
                 raise _Abort()
             try:
-                return q.get(timeout=_POLL_S)
+                item = q.get(timeout=_POLL_S)
+                self._q_gauges[id(q)].set(q.qsize())
+                return item
             except queue.Empty:
                 continue
 
@@ -194,18 +219,23 @@ class SlabPipeline:
                 if self.abort.is_set():
                     raise _Abort()
                 chunk = self.docs[base : base + self.slab]
-                self.prefetch(chunk)
-                for doc in chunk:
-                    kind, payload = self.classify(doc)
-                    if kind == "entry":
-                        buf.append(payload)
-                        if len(buf) == self.slab:
-                            self._put(self.pack_q, buf)
-                            buf = []
-                    elif kind == "memo":
-                        self.memo_hits.append(payload)
-                    else:
-                        self.fallbacks.append(payload)
+                t0 = time.perf_counter()
+                with telemetry.span("pipeline.io", "pipeline"):
+                    self.prefetch(chunk)
+                    for doc in chunk:
+                        kind, payload = self.classify(doc)
+                        if kind == "entry":
+                            buf.append(payload)
+                        elif kind == "memo":
+                            self.memo_hits.append(payload)
+                        else:
+                            self.fallbacks.append(payload)
+                _M_BUSY["io"].add(time.perf_counter() - t0)
+                # the put blocks on a full queue: that's backpressure
+                # WAIT, not io busy — keep it outside the busy window
+                while len(buf) >= self.slab:
+                    self._put(self.pack_q, buf[: self.slab])
+                    buf = buf[self.slab :]
             if buf:
                 self._put(self.pack_q, buf)
             self._put(self.pack_q, _DONE)
@@ -221,7 +251,12 @@ class SlabPipeline:
                 if item is _DONE:
                     self._put(self.disp_q, _DONE)
                     return
-                self._put(self.disp_q, (item, self.pack(item)))
+                t0 = time.perf_counter()
+                with telemetry.span("pipeline.pack", "pipeline"):
+                    packed = self.pack(item)
+                _M_BUSY["pack"].add(time.perf_counter() - t0)
+                _M_SLABS.add(1)
+                self._put(self.disp_q, (item, packed))
         except _Abort:
             pass
         except BaseException as e:
@@ -236,7 +271,10 @@ class SlabPipeline:
                     # overlaps across chips) see it and drain too
                     self._put(self.fetch_q, _DONE)
                     return
-                self.fetch(item)
+                t0 = time.perf_counter()
+                with telemetry.span("pipeline.fetch", "pipeline"):
+                    self.fetch(item)
+                _M_BUSY["fetch"].add(time.perf_counter() - t0)
         except _Abort:
             pass
         except BaseException as e:
@@ -276,7 +314,11 @@ class SlabPipeline:
                 if item is _DONE:
                     break
                 entries, batch = item
-                self._put(self.fetch_q, self.dispatch(entries, batch))
+                t0 = time.perf_counter()
+                with telemetry.span("pipeline.dispatch", "pipeline"):
+                    pending = self.dispatch(entries, batch)
+                _M_BUSY["dispatch"].add(time.perf_counter() - t0)
+                self._put(self.fetch_q, pending)
             self._put(self.fetch_q, _DONE)
         except _Abort:
             pass

@@ -43,11 +43,16 @@ from ..storage.stores import (
 from ..utils import keys as keymod
 from ..utils.debug import log
 from ..utils.ids import root_actor_id
+from .. import telemetry
 from ..utils.queue import Queue
 from ..files.file_store import FileStore
 from .actor import Actor
 from .doc_backend import DocBackend
 from .metadata import Metadata
+
+# device->host summary-wire transfer bytes (same series sharded.py's
+# collective gather feeds; handle cached — one per-slab bump)
+_M_D2H = telemetry.counter("mesh.d2h_bytes")
 
 
 class RepoBackend:
@@ -542,6 +547,17 @@ class RepoBackend:
 
         `pad_docs`/`pad_rows` override the slab's jit bucket (benchmarks
         prime a [4096, N] executable with a small load)."""
+        with telemetry.span(
+            "pipeline.bulk_load", "pipeline", docs=len(doc_ids)
+        ):
+            return self._load_documents_bulk(
+                doc_ids, slab, pad_docs, pad_rows
+            )
+
+    def _load_documents_bulk(
+        self, doc_ids: List[str], slab: Optional[int],
+        pad_docs: Optional[int], pad_rows: Optional[int],
+    ) -> None:
         if slab is None:
             slab = int(os.environ.get("HM_BULK_SLAB", "4096"))
         with self._bulk_mutex:  # concurrent open_many calls serialize
@@ -922,7 +938,10 @@ class RepoBackend:
         _ids, batch, _dec, wire, lean = entry
         if wire is None or isinstance(wire, dict):
             return
+        nbytes = getattr(wire, "nbytes", 0)
         entry[3] = fetch_summary(wire, batch, lean)
+        if nbytes:
+            _M_D2H.add(nbytes)
 
     def _begin_bulk_actors(self) -> None:
         """Defer per-feed sqlite writes and actor syncs for the duration
@@ -1612,12 +1631,15 @@ class RepoBackend:
         # the feed bytes it describes (HM_FSYNC>=1 syncs dirty feed
         # logs here; tier 0 relies on recovery-on-open clamping
         # instead — storage/durability.py)
-        self.durability.barrier()
-        with self.db.bulk():
-            if clocks:
-                self.clocks.update_many(self.id, clocks)
-            if cursor_rows:
-                self.cursors.update_many_rows(self.id, cursor_rows)
+        with telemetry.span(
+            "storage.store_flush", "storage", rows=len(batch)
+        ):
+            self.durability.barrier()
+            with self.db.bulk():
+                if clocks:
+                    self.clocks.update_many(self.id, clocks)
+                if cursor_rows:
+                    self.cursors.update_many_rows(self.id, cursor_rows)
 
     def _doc_notify(self, event: Dict[str, Any]) -> None:
         t = event["type"]
@@ -1748,6 +1770,13 @@ class RepoBackend:
                     "history": doc.history_len,
                 }
             self.to_frontend.push(msgs.reply_msg(query_id, payload))
+        elif t == "Telemetry":
+            # live introspection over the IPC/serve seam (tools/top.py):
+            # the process-wide registry snapshot + trace state, stamped
+            # for rate computation between polls
+            self.to_frontend.push(
+                msgs.reply_msg(query_id, telemetry.query_payload())
+            )
         else:
             self.to_frontend.push(msgs.reply_msg(query_id, None))
 

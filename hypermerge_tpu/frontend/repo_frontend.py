@@ -176,6 +176,11 @@ class RepoFrontend:
         _scheme, id_ = validate_url(url)
         self._query(msgs.metadata_query(id_), cb)
 
+    def telemetry(self, cb: Callable[[Any], None]) -> None:
+        """The backend process' telemetry snapshot (registry counters,
+        trace state) — what tools/top.py polls for live rates."""
+        self._query(msgs.telemetry_query(), cb)
+
     def message(self, url: str, contents: Any) -> None:
         doc_id = validate_doc_url(url)
         self.to_backend.push(msgs.doc_message_msg(doc_id, contents))

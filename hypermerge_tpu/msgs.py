@@ -74,6 +74,13 @@ def metadata_query(url_id: str) -> Dict[str, Any]:
     return {"type": "Metadata", "id": url_id}
 
 
+def telemetry_query() -> Dict[str, Any]:
+    """Process-wide telemetry snapshot (counters + trace state) from
+    the backend — the live-introspection feed tools/top.py polls over
+    the IPC/serve seam."""
+    return {"type": "Telemetry"}
+
+
 # ---------------------------------------------------------------------------
 # backend -> frontend
 

@@ -64,6 +64,7 @@ from ..storage.feed import Feed, FeedStore
 from ..storage.integrity import allow_unsigned, capability
 from ..utils.debug import log
 from ..utils.mapset import MapSet
+from .. import telemetry
 from .peer import NetworkPeer
 
 CHANNEL = "Replication"
@@ -126,8 +127,19 @@ class ReplicationManager:
         self._sparse_wanted: Dict[str, Set[int]] = {}
         # churn accounting: a peer re-activating after a close is a
         # RESYNC (the supervised redial restored it); t_resync_ms sums
-        # redial -> first post-reconnect replication data frame
-        self.stats: Dict[str, float] = {"resyncs": 0, "t_resync_ms": 0.0}
+        # redial -> first post-reconnect replication data frame.
+        # Series live on the process telemetry registry (labeled per
+        # manager); `stats` rebuilds the historical dict. The sharded
+        # counter closes the old unlocked `stats["t_resync_ms"] +=`
+        # read-modify-write race from reader threads.
+        inst = str(telemetry.next_instance())
+        self._m = {
+            k: telemetry.counter("net.repl." + k, inst=inst)
+            for k in (
+                "resyncs", "t_resync_ms", "antientropy_sweeps",
+                "frames_tx", "frames_rx",
+            )
+        }
         self._seen_closed: Set[str] = set()
         self._resync_t0: Dict[str, float] = {}
         # live-tail coalescing: public_key -> earliest unflushed block,
@@ -148,7 +160,19 @@ class ReplicationManager:
         self._ae_interval = _antientropy_s()
         self._ae_stop = threading.Event()
         self._ae_thread: Optional[threading.Thread] = None
-        self.stats["antientropy_sweeps"] = 0
+
+    @property
+    def stats(self) -> Dict[str, float]:
+        """The historical stats dict shape (registry-backed,
+        read-only): resyncs, t_resync_ms, antientropy_sweeps."""
+        m = self._m
+        return {
+            "resyncs": int(m["resyncs"].value()),
+            "t_resync_ms": round(m["t_resync_ms"].value(), 6),
+            "antientropy_sweeps": int(
+                m["antientropy_sweeps"].value()
+            ),
+        }
 
     # ------------------------------------------------------------------
 
@@ -167,7 +191,7 @@ class ReplicationManager:
         with self._lock:
             self._peers.add(peer)
             if peer.id in self._seen_closed:
-                self.stats["resyncs"] += 1
+                self._m["resyncs"].add(1)
                 self._resync_t0[peer.id] = time.monotonic()
             if self._ae_thread is None and self._ae_interval > 0:
                 self._ae_thread = threading.Thread(
@@ -223,6 +247,7 @@ class ReplicationManager:
     def _on_message(self, peer: NetworkPeer, msg: Dict) -> None:
         if not isinstance(msg, dict):
             return
+        self._m["frames_rx"].add(1)
         try:
             t = msg.get("type")
             if t != "DiscoveryIds" and self._resync_t0:
@@ -239,7 +264,11 @@ class ReplicationManager:
                 if t0 is not None:
                     elapsed = time.monotonic() - t0
                     if elapsed < 60:
-                        self.stats["t_resync_ms"] += elapsed * 1e3
+                        self._m["t_resync_ms"].add(elapsed * 1e3)
+                        telemetry.instant(
+                            "net.resync", cat="net",
+                            ms=round(elapsed * 1e3, 1),
+                        )
             if t == "DiscoveryIds":
                 if "challenge" in msg:
                     with self._lock:
@@ -705,14 +734,17 @@ class ReplicationManager:
         feed.on_extended(on_extended)
 
     def _flush_batch(self, batch: Dict[str, int]) -> None:
-        for pk, start in batch.items():
-            feed = self.feeds.get_feed(pk)
-            if feed is None:
-                continue
-            try:
-                self._flush_feed(feed, start)
-            except Exception as e:  # a bad feed must not kill tails
-                log("replication", f"tail flush failed {pk[:6]}: {e}")
+        with telemetry.span("net.repl.flush", "net", feeds=len(batch)):
+            for pk, start in batch.items():
+                feed = self.feeds.get_feed(pk)
+                if feed is None:
+                    continue
+                try:
+                    self._flush_feed(feed, start)
+                except Exception as e:  # a bad feed must not kill tails
+                    log(
+                        "replication", f"tail flush failed {pk[:6]}: {e}"
+                    )
 
     def _flush_feed(self, feed: Feed, start: int) -> None:
         did = feed.discovery_id
@@ -784,13 +816,25 @@ class ReplicationManager:
                 if msg is not None:
                     self._send(peer, msg)
                     sent += 1
-        self.stats["antientropy_sweeps"] += 1
+        self._m["antientropy_sweeps"].add(1)
         return sent
 
     def close(self) -> None:
         self._ae_stop.set()
         # drains: tails marked before close still reach peers
         self._flusher.close()
+        # join the sweep thread BEFORE retiring the series: a sweep
+        # finishing after the fold would bump a dropped handle and the
+        # process snapshot would undercount rm.stats forever. The join
+        # is bounded by one in-flight sweep (the stop flag already
+        # short-circuits the next wait).
+        t = self._ae_thread
+        if t is not None:
+            t.join(timeout=10.0)
+        # registry hygiene: fold this manager's series into the closed
+        # aggregate (stats stays readable — it is handle-based)
+        telemetry.REGISTRY.retire(*self._m.values())
 
     def _send(self, peer: NetworkPeer, msg: Dict) -> None:
+        self._m["frames_tx"].add(1)
         peer.try_send(CHANNEL, msg)

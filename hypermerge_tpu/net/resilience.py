@@ -136,7 +136,22 @@ class SessionSupervisor:
         self._lock = threading.RLock()
         self._sessions: Dict[Any, Session] = {}
         self._stopped = False
-        self.stats = {"dials": 0, "reconnects": 0}
+        # registry-backed (one labeled series per supervisor); the
+        # `stats` property keeps the historical dict shape
+        from .. import telemetry
+
+        inst = str(telemetry.next_instance())
+        self._m = {
+            k: telemetry.counter("net.sup." + k, inst=inst)
+            for k in ("dials", "reconnects")
+        }
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {
+            "dials": int(self._m["dials"].value()),
+            "reconnects": int(self._m["reconnects"].value()),
+        }
 
     def on_status(
         self, cb: Callable[[Session, str, dict], None]
@@ -172,6 +187,7 @@ class SessionSupervisor:
             target=self._run, args=(s,), daemon=True,
             name=f"redial:{address}",
         )
+        s._thread = t  # stop() joins before retiring the counters
         t.start()
         return s
 
@@ -181,6 +197,20 @@ class SessionSupervisor:
             sessions = list(self._sessions.values())
         for s in sessions:
             s.kick()
+        # bounded join before retiring the series: a session thread
+        # bumping `dials` after the fold would land on a dropped
+        # handle (kick() already interrupts backoff sleeps; only a
+        # dial mid-flight can outlive the bound, and it re-checks
+        # stopped before any further counting)
+        for s in sessions:
+            t = getattr(s, "_thread", None)
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=1.0)
+        # registry hygiene (idempotent): fold this supervisor's series
+        # into the closed aggregate; stats stays handle-readable
+        from .. import telemetry
+
+        telemetry.REGISTRY.retire(*self._m.values())
 
     # ------------------------------------------------------------------
 
@@ -221,7 +251,7 @@ class SessionSupervisor:
                     self._stop_session(s, "reconnect disallowed")
                     return
             self._status(s, CONNECTING, attempt=s.backoff.attempt)
-            self.stats["dials"] += 1
+            self._m["dials"].add(1)
             try:
                 duplex = self._dial(s.address)
             except OSError as e:
@@ -250,7 +280,7 @@ class SessionSupervisor:
             t_up = time.monotonic()
             s.connects += 1
             if s.connects > 1:
-                self.stats["reconnects"] += 1
+                self._m["reconnects"].add(1)
             self._status(s, CONNECTED, connects=s.connects)
             try:
                 self._deliver(duplex, details)

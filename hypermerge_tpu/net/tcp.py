@@ -25,11 +25,24 @@ from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
 from ..utils.debug import log
+from .. import telemetry
 from .resilience import SessionSupervisor, dial_timeout_s
 from .swarm import ConnectionDetails, Swarm
 
 _HDR = struct.Struct("<I")
 _MAX_FRAME = 64 * 1024 * 1024
+
+# process-wide transport counters (every duplex shares them): frame +
+# byte rates are the wire-level truth tools/top.py graphs under the
+# per-channel replication counters. Counter.add is per-thread-sharded
+# (one dict hit + one float add) — noise on a path that JSON-encodes
+# and encrypts every frame.
+_M_FRAMES_TX = telemetry.counter("net.tcp.frames_tx")
+_M_FRAMES_RX = telemetry.counter("net.tcp.frames_rx")
+_M_BYTES_TX = telemetry.counter("net.tcp.bytes_tx")
+_M_BYTES_RX = telemetry.counter("net.tcp.bytes_rx")
+_M_PINGS = telemetry.counter("net.tcp.pings_tx")
+_M_SHEDS = telemetry.counter("net.tcp.sheds")
 
 # keepalive frames: duplex-level, never delivered to subscribers. A
 # pre-keepalive peer drops them as malformed channel frames
@@ -248,11 +261,13 @@ class TcpDuplex:
                     )
                     # a peer that answers no pings is by definition
                     # not draining: skip close()'s bounded drain wait
+                    _M_SHEDS.add(1)
                     self._shed = True
                     self.close()
                     return
             if now - self._last_rx >= period:
                 self.send({_PING: misses})
+                _M_PINGS.add(1)
                 last_probe = now
 
     def send(self, msg: Any) -> None:
@@ -288,6 +303,7 @@ class TcpDuplex:
                 f"outbox over cap ({self._out_bytes}B) with a stalled "
                 "writer: peer not draining, shedding connection",
             )
+            _M_SHEDS.add(1)
             self._shed = True
             self.close()
 
@@ -313,6 +329,8 @@ class TcpDuplex:
                 if self._session is not None:
                     data = self._session.encrypt(data)
                 self._sock.sendall(_HDR.pack(len(data)) + data)
+                _M_FRAMES_TX.add(1)
+                _M_BYTES_TX.add(_HDR.size + len(data))
                 self._last_progress = time.monotonic()
             except OSError:
                 # signal BEFORE close(): a concurrent closer may be
@@ -350,6 +368,8 @@ class TcpDuplex:
             payload = self._read_exact(size)
             if payload is None:
                 break
+            _M_FRAMES_RX.add(1)
+            _M_BYTES_RX.add(_HDR.size + size)
             self._last_rx = time.monotonic()  # any frame is liveness
             if self._session is not None:
                 payload = self._session.decrypt(payload)

@@ -51,7 +51,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.columnar import ColumnarBatch
 from ..ops.crdt_kernels import MaterializeOut, batched_kernel
+from .. import telemetry
 from .mesh import doc_actor_sharding, doc_sharding, pad_to_multiple
+
+# mesh telemetry (process registry): program dispatches, retraces
+# (mirrors trace_counts, which stays the per-key regression-test
+# truth), and host<->device transfer bytes — the "is the mesh being
+# fed" view tools/top.py renders next to pipeline queue depths.
+_M_DISPATCHES = telemetry.counter("mesh.dispatches")
+_M_TRACES = telemetry.counter("mesh.traces")
+_M_H2D = telemetry.counter("mesh.h2d_bytes")
+_M_D2H = telemetry.counter("mesh.d2h_bytes")
 
 # narrow wire-arg order, matching ops.crdt_kernels.host_args; pad-doc
 # rows must decode to action=PAD (flags=7), insert=0
@@ -88,6 +98,7 @@ def _traced(key: Tuple, fn: Callable) -> Callable:
 
     def wrapper(*args):
         trace_counts[key] = trace_counts.get(key, 0) + 1
+        _M_TRACES.add(1)
         return fn(*args)
 
     return wrapper
@@ -282,7 +293,8 @@ def sharded_full(batch: ColumnarBatch, mesh: Mesh, lean: bool = False):
     collectives — linear scaling over dp."""
     args, A, K, _ = shard_batch(batch, mesh)
     jfn = _full_program(mesh, A, K, batch.n_rows, lean)
-    with mesh:
+    _M_DISPATCHES.add(1)
+    with mesh, telemetry.span("mesh.sharded_full", "mesh"):
         return jfn(*args)
 
 
@@ -458,7 +470,8 @@ def step(batch: ColumnarBatch, mesh: Mesh):
     args, A, K, _ = shard_batch(batch, mesh)
     n_actors = max(1, len(batch.actors))
     fn = _step_program(mesh, A, K, n_actors)
-    with mesh:
+    _M_DISPATCHES.add(1)
+    with mesh, telemetry.span("mesh.step", "mesh"):
         return fn(*args)
 
 
@@ -635,8 +648,15 @@ class SlabRoundRobin:
         while len(q) >= self.depth:
             q.pop(0).block_until_ready()
         t0 = time.perf_counter()
-        out, summary = run_batch_full(
-            batch, lean=lean, device=self.devices[i]
+        with telemetry.span("mesh.dispatch", "mesh"):
+            out, summary = run_batch_full(
+                batch, lean=lean, device=self.devices[i]
+            )
+        _M_DISPATCHES.add(1)
+        _M_H2D.add(
+            sum(a.nbytes for a in batch.cols.values())
+            + batch.psrc.nbytes
+            + batch.ptgt.nbytes
         )
         self.t_dispatch_chip[i] += time.perf_counter() - t0
         self.slabs_per_chip[i] += 1
@@ -864,6 +884,7 @@ class MeshBulkScheduler(SlabRoundRobin):
                 )
                 with self.mesh:
                     host = np.asarray(gfn(arr))
+            _M_D2H.add(host.nbytes)
             for i in range(len(self.devices)):
                 base = i * rows
                 for seq, n_docs, wire in per_chip.get(i, []):

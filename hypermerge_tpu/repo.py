@@ -76,6 +76,10 @@ class Repo:
     def meta(self, url: str, cb: Callable[[Any], None]) -> None:
         self.front.meta(url, cb)
 
+    def telemetry(self, cb: Callable[[Any], None]) -> None:
+        """Backend telemetry snapshot (see RepoFrontend.telemetry)."""
+        self.front.telemetry(cb)
+
     def message(self, url: str, contents: Any) -> None:
         self.front.message(url, contents)
 

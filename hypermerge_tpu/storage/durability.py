@@ -41,6 +41,14 @@ import threading
 from typing import Optional, Set
 
 from ..utils.debug import log
+from .. import telemetry
+
+# storage durability counters (process registry): fsync passes, the
+# storages they synced, failures, and pre-sqlite barriers — the
+# "is durability keeping up" view for HM_FSYNC=1 daemons
+_M_SYNCS = telemetry.counter("storage.fsyncs")
+_M_SYNC_ERRS = telemetry.counter("storage.fsync_errors")
+_M_BARRIERS = telemetry.counter("storage.barriers")
 
 
 def fsync_tier() -> int:
@@ -100,19 +108,32 @@ class DurabilityManager:
             self._dirty.clear()
         n = 0
         first_err: Optional[OSError] = None
-        for s in dirty:
-            try:
-                s.sync()
-                n += 1
-            except OSError as e:
-                log("storage:durability", f"sync failed: {e}")
-                if first_err is None:
-                    first_err = e
-                with self._lock:
-                    if not self._closed:
-                        self._dirty.add(s)
-                        if self._flusher is not None:
-                            self._flusher.mark("sync")
+        sp = (
+            telemetry.begin("storage.fsync_group", "storage",
+                            n=len(dirty))
+            if dirty
+            else telemetry.NOOP
+        )
+        try:
+            for s in dirty:
+                try:
+                    s.sync()
+                    n += 1
+                except OSError as e:
+                    log("storage:durability", f"sync failed: {e}")
+                    _M_SYNC_ERRS.add(1)
+                    if first_err is None:
+                        first_err = e
+                    with self._lock:
+                        if not self._closed:
+                            self._dirty.add(s)
+                            if self._flusher is not None:
+                                self._flusher.mark("sync")
+        finally:
+            # a non-OSError escaping a sync (ValueError from a closed
+            # file) must not drop the span or the already-synced count
+            sp.end()
+            _M_SYNCS.add(n)
         if first_err is not None:
             raise first_err
         return n
@@ -124,6 +145,7 @@ class DurabilityManager:
         RAISES on a failed fsync: the caller must NOT commit rows for
         bytes that never reached the platter — the store debouncer
         re-queues the batch and retries with backoff."""
+        _M_BARRIERS.add(1)
         if self.tier >= 1:
             self.sync_now()
 

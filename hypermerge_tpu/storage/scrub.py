@@ -38,6 +38,7 @@ import time
 from typing import Dict, Optional, Set
 
 from ..utils.debug import log
+from .. import telemetry
 from .integrity import allow_unsigned
 
 REPORT_NAME = "scrub.json"
@@ -124,6 +125,20 @@ def recover_repo(back, repair: bool = True) -> Dict:
     RepoBackend, BEFORE any doc is opened. Returns (and persists) the
     report. With repair=False nothing is written — the report describes
     what a repair would do (tools/scrub.py --dry-run)."""
+    # span lands even when recovery RAISES (the trace you want most is
+    # the failed one); the counter only counts completed recoveries
+    sp = telemetry.begin("storage.recover", "storage")
+    ok = False
+    try:
+        report = _recover_repo(back, repair)
+        ok = True
+    finally:
+        sp.end(ok=ok)
+    telemetry.counter("storage.recoveries").add(1)
+    return report
+
+
+def _recover_repo(back, repair: bool) -> Dict:
     t0 = time.perf_counter()
     report: Dict = {k: 0 for k in _COUNTERS}
     per_feed: Dict[str, Dict] = {}

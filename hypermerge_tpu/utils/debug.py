@@ -19,13 +19,51 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, Tuple
 
-_PATTERNS = [
-    p for p in re.split(r"[\s,]+", os.environ.get("DEBUG", "")) if p
-]
+# Patterns re-resolve at CALL time, not import time: a daemon can
+# toggle namespaces without a restart, either programmatically
+# (set_patterns) or by mutating os.environ["DEBUG"] — the env string
+# is compared each call (one dict lookup) and only re-parsed on
+# change. set_patterns() overrides the env until set_patterns(None).
+_env_cache: str = ""
+_env_patterns: list = []
+_override: "list | None" = None
+_patterns_lock = threading.Lock()
+
+
+def _parse(spec: str) -> list:
+    return [p for p in re.split(r"[\s,]+", spec) if p]
+
+
+def set_patterns(spec=None) -> None:
+    """Set the active DEBUG patterns at runtime. ``spec`` is a
+    DEBUG-style string ("live,net:*") or an iterable of patterns;
+    ``None`` returns control to the DEBUG env var."""
+    global _override
+    if spec is None:
+        _override = None
+    elif isinstance(spec, str):
+        _override = _parse(spec)
+    else:
+        _override = [str(p) for p in spec]
+
+
+def _current_patterns() -> list:
+    if _override is not None:
+        return _override
+    global _env_cache, _env_patterns
+    env = os.environ.get("DEBUG", "")
+    if env != _env_cache:
+        with _patterns_lock:
+            if env != _env_cache:
+                _env_patterns = _parse(env)
+                _env_cache = env
+    return _env_patterns
 
 
 def enabled(namespace: str) -> bool:
-    return any(fnmatch.fnmatch(namespace, pat) for pat in _PATTERNS)
+    return any(
+        fnmatch.fnmatch(namespace, pat) for pat in _current_patterns()
+    )
 
 
 def log(namespace: str, *args: Any) -> None:

@@ -322,3 +322,93 @@ def test_ls_surfaces_recovery_status(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "crash recovery ran" in out.stdout
     assert "scrub=ok" in out.stdout or "scrub=recovered" in out.stdout
+
+
+def test_top_over_ipc_seam(tmp_path):
+    """tools/top.py's client polls the backend Telemetry query over
+    the net/ipc.py unix socket and renders per-subsystem rates."""
+    import importlib.util
+    import threading
+
+    from hypermerge_tpu.net.ipc import serve_backend
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    repo.create({"n": 1})
+    repo.close()
+
+    sock = str(tmp_path / "b.sock")
+    t = threading.Thread(
+        target=serve_backend,
+        args=(sock,),
+        kwargs=dict(repo_path=path, once=True),
+        daemon=True,
+    )
+    t.start()
+    for _ in range(200):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.05)
+    spec = importlib.util.spec_from_file_location(
+        "hm_top", os.path.join(REPO_ROOT, "tools", "top.py")
+    )
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    client = top.IpcTelemetry(sock)
+    try:
+        p1 = client.poll()
+        p2 = client.poll()
+        assert isinstance(p1["counters"], dict) and p1["counters"]
+        assert p2["time"] >= p1["time"]
+        table = top.format_rows(
+            p1, p2, max(p2["time"] - p1["time"], 1e-3)
+        )
+        # the unix-socket chatter itself shows up as net counters
+        assert "[net]" in table
+        assert "net.tcp.frames_rx" in table
+    finally:
+        client.close()
+    t.join(15)
+    assert not t.is_alive()
+
+
+def test_meta_stats_snapshot(tmp_path):
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    repo.create({"n": 1})
+    repo.close()
+    out = _run(["tools/meta.py", path, "--stats"])
+    assert out.returncode == 0, out.stderr
+    snap = json.loads(out.stdout.strip())
+    # registry-sourced names, not per-object dict scrapes
+    assert "storage.barriers" in snap
+    assert any(k.startswith("live.") for k in snap)
+
+
+def test_profile_trace_timeline(tmp_path):
+    """scripts/profile_trace.py replays an HM_TRACE file into the
+    busy-vs-wall timeline."""
+    from hypermerge_tpu import telemetry
+    from hypermerge_tpu.telemetry import trace as ttrace
+
+    ttrace.reset()
+    ttrace.enable()
+    try:
+        for _ in range(3):
+            with telemetry.span("live.tick", cat="live"):
+                time.sleep(0.002)
+        with telemetry.span("pipeline.pack", cat="pipeline"):
+            time.sleep(0.005)
+        telemetry.instant("live.demote", cat="live")
+        trace_path = str(tmp_path / "t.json")
+        telemetry.flush_trace(trace_path)
+    finally:
+        ttrace.disable()
+        ttrace.reset()
+
+    out = _run(["scripts/profile_trace.py", trace_path, "--threads"])
+    assert out.returncode == 0, out.stderr
+    assert "live.tick" in out.stdout and "x3" in out.stdout
+    assert "concurrency" in out.stdout
+    out = _run(["scripts/profile_trace.py", trace_path, "--by", "cat"])
+    assert "pipeline" in out.stdout

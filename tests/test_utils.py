@@ -349,3 +349,38 @@ def test_debouncer_adaptive_window_stretches_under_load():
     # with ~0.05s flushes over 0.5s, a non-adaptive 1ms window would do
     # hundreds of flushes; adaptation caps it near duration/flush_time
     assert len(batches) <= 14, len(batches)
+
+
+# ---------------------------------------------------------------------------
+# debug namespaces honor RUNTIME changes (round 13: daemons toggle
+# namespaces without a restart — the patterns were parsed once at
+# import before)
+
+
+def test_debug_enabled_tracks_env_changes(monkeypatch):
+    from hypermerge_tpu.utils import debug
+
+    monkeypatch.setenv("DEBUG", "")
+    assert not debug.enabled("live")
+    monkeypatch.setenv("DEBUG", "live,net:*")
+    assert debug.enabled("live")
+    assert debug.enabled("net:tcp")
+    assert not debug.enabled("storage")
+    monkeypatch.setenv("DEBUG", "storage")
+    assert debug.enabled("storage")
+    assert not debug.enabled("live")
+
+
+def test_debug_set_patterns_overrides_env(monkeypatch):
+    from hypermerge_tpu.utils import debug
+
+    monkeypatch.setenv("DEBUG", "live")
+    debug.set_patterns("repl*")
+    try:
+        assert debug.enabled("replication")
+        assert not debug.enabled("live")  # override wins over env
+        debug.set_patterns(["a", "b:*"])
+        assert debug.enabled("b:x") and debug.enabled("a")
+    finally:
+        debug.set_patterns(None)  # back to the env
+    assert debug.enabled("live")

@@ -75,6 +75,23 @@ def main() -> None:
         f"repo {back.id[:8]}…  {len(doc_ids)} docs"
         + ("  (crash recovery ran on this open)" if recovered else "")
     )
+    # telemetry summary for THIS open (registry-sourced — the
+    # per-object stats dicts this used to require are gone):
+    # what opening the repo cost in recoveries/fsyncs so far
+    from hypermerge_tpu import telemetry
+
+    snap = telemetry.snapshot()
+    tele_keys = (
+        "storage.recoveries", "storage.fsyncs", "storage.barriers",
+        "pipeline.slabs", "mesh.dispatches", "live.adopted",
+    )
+    tele = " ".join(
+        f"{k.split('.', 1)[1]}={snap[k]}"
+        for k in tele_keys
+        if snap.get(k)
+    )
+    if tele:
+        print(f"telemetry: {tele}")
     for doc_id in doc_ids:
         cursor = back.cursors.get(back.id, doc_id)
         clock = back.clocks.get(back.id, doc_id)

@@ -5,6 +5,7 @@ tools/Meta.ts — `repo.meta(url, cb)` surfaced on the command line).
     python tools/meta.py /path/to/repo 'hypermerge:/<docId>'
     python tools/meta.py /path/to/repo 'hyperfile:/<fileId>'
     python tools/meta.py --devices
+    python tools/meta.py /path/to/repo --stats
 
 Output is one JSON object. Documents are opened first (metadata queries
 answer from the open doc's backend state); unknown urls print null and
@@ -15,6 +16,12 @@ needed): device count, platform/kind, (dp, sp) mesh shape, and whether
 the Pallas ICI remote-copy path is live — the same object the bench
 embeds as `multichip_topology`, so a bench JSON line is auditable
 against the box it ran on.
+
+`--stats` opens the repo (and its docs) and prints the process-wide
+telemetry snapshot JSON — the registry every subsystem now reports
+into (hypermerge_tpu/telemetry/) instead of the per-object stats
+dicts it replaced. Same counter names as bench.py's `telemetry`
+block and tools/top.py.
 """
 
 import argparse
@@ -44,12 +51,26 @@ def main() -> None:
         "--devices", action="store_true",
         help="print visible device / mesh topology JSON and exit",
     )
+    ap.add_argument(
+        "--stats", action="store_true",
+        help="open the repo and print the telemetry registry snapshot",
+    )
     args = ap.parse_args()
 
     if args.devices:
         from hypermerge_tpu.parallel.mesh import device_topology
 
         print(json.dumps(device_topology(), sort_keys=True), flush=True)
+        return
+    if args.stats:
+        if args.repo is None:
+            ap.error("--stats requires a repo directory")
+        from hypermerge_tpu import telemetry
+
+        payload = telemetry.snapshot_repo(args.repo)
+        print(
+            json.dumps(payload["counters"], sort_keys=True), flush=True
+        )
         return
     if args.repo is None or args.url is None:
         ap.error("repo and url are required (or use --devices)")

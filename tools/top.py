@@ -1,0 +1,209 @@
+"""Live per-subsystem telemetry for a running hypermerge daemon.
+
+Polls the backend's ``Telemetry`` query over the IPC/serve seam
+(net/ipc.py unix socket) and renders per-subsystem counter RATES — the
+"what is this daemon doing right now" view ISSUE 9 asked for: live
+ticks/s, replication frames/s, TCP bytes/s, fsync barriers/s, mesh
+dispatches/s, pipeline queue depths.
+
+    # against a daemon (python -m hypermerge_tpu.net.ipc repo sock --persist)
+    python tools/top.py --sock /tmp/backend.sock [--interval 1.0]
+
+    # one shot, machine-readable
+    python tools/top.py --sock /tmp/backend.sock --once --json
+
+    # one in-process snapshot of a repo on disk (no daemon needed)
+    python tools/top.py /path/to/repo --once [--prom]
+
+Counter names are ``<subsystem>.<metric>`` (see
+hypermerge_tpu/telemetry/__init__.py); the left column groups by the
+prefix. Rates are exact deltas between polls of the merged per-thread
+shards — no sampling.
+"""
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+class IpcTelemetry:
+    """A minimal Telemetry-query client on the backend's unix socket —
+    the same framed duplex a RepoFrontend uses, without needing one
+    (top must not open docs or mutate frontend state)."""
+
+    def __init__(self, sock_path: str) -> None:
+        from hypermerge_tpu.net.tcp import TcpDuplex
+
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.connect(sock_path)
+        self._duplex = TcpDuplex(sock, is_client=True)
+        if self._duplex.closed:
+            raise ConnectionError(
+                f"handshake with backend at {sock_path} failed"
+            )
+        self._lock = threading.Lock()
+        self._next_qid = 0
+        self._waiting = {}
+        self._duplex.on_message(self._on_msg)
+
+    def _on_msg(self, msg) -> None:
+        if not isinstance(msg, dict) or msg.get("type") != "Reply":
+            return  # patches/gossip from the live daemon: not ours
+        with self._lock:
+            slot = self._waiting.pop(msg.get("queryId"), None)
+        if slot is not None:
+            slot["payload"] = msg.get("payload")
+            slot["event"].set()
+
+    def poll(self, timeout: float = 10.0) -> dict:
+        from hypermerge_tpu import msgs
+
+        slot = {"event": threading.Event(), "payload": None}
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+            self._waiting[qid] = slot
+        self._duplex.send(msgs.query_msg(qid, msgs.telemetry_query()))
+        if not slot["event"].wait(timeout):
+            with self._lock:  # retries must not leak a slot per miss
+                self._waiting.pop(qid, None)
+            raise TimeoutError("telemetry query timed out")
+        payload = slot["payload"]
+        if not isinstance(payload, dict):
+            raise RuntimeError(
+                "backend does not answer Telemetry queries "
+                "(pre-round-13 daemon?)"
+            )
+        return payload
+
+    def close(self) -> None:
+        self._duplex.close()
+
+
+def format_rows(prev: dict, cur: dict, dt: float) -> str:
+    """The rendered table: counters grouped by subsystem prefix, with
+    per-second deltas against the previous poll (blank on the first)."""
+    counters = cur.get("counters", {})
+    prev_counters = (prev or {}).get("counters", {})
+    by_sub = {}
+    for name, v in counters.items():
+        sub = name.split(".", 1)[0]
+        by_sub.setdefault(sub, []).append((name, v))
+    lines = []
+    for sub in sorted(by_sub):
+        rows = [
+            (n, v, v - prev_counters.get(n, 0))
+            for n, v in sorted(by_sub[sub])
+        ]
+        if not any(v or d for _n, v, d in rows):
+            continue  # a fully idle subsystem earns no screen space
+        lines.append(f"[{sub}]")
+        for name, v, delta in rows:
+            if not v and not delta:
+                continue
+            rate = ""
+            if prev and dt > 0 and delta:
+                # signed: a draining queue gauge shows a negative rate
+                rate = f"  ({delta / dt:+,.1f}/s)"
+            if isinstance(v, float):
+                v = round(v, 3)
+            lines.append(f"  {name:<32} {v:>14,}{rate}")
+    if cur.get("tracing"):
+        lines.append(
+            f"[trace] {cur.get('trace_spans', 0)} spans buffered"
+            + (
+                f" -> {cur['trace_path']}"
+                if cur.get("trace_path")
+                else " (in-memory ring)"
+            )
+        )
+    return "\n".join(lines)
+
+
+def _in_process_payload(repo_path: str) -> dict:
+    """Open the repo in-process and snapshot its registry (no daemon:
+    the numbers describe THIS process' open, not a running server).
+    Shares the exact recipe with tools/meta.py --stats."""
+    from hypermerge_tpu import telemetry
+
+    return telemetry.snapshot_repo(repo_path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("repo", nargs="?", help="repo directory (in-process)")
+    ap.add_argument("--sock", help="daemon unix socket (net/ipc.py)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument(
+        "--once", action="store_true", help="print one snapshot and exit"
+    )
+    ap.add_argument("--json", action="store_true", help="raw JSON payload")
+    ap.add_argument(
+        "--prom", action="store_true",
+        help="Prometheus text snapshot (in-process mode only)",
+    )
+    ap.add_argument(
+        "--no-clear", action="store_true",
+        help="append instead of redrawing the screen",
+    )
+    args = ap.parse_args()
+    if not args.sock and not args.repo:
+        ap.error("need --sock SOCKPATH or a repo directory")
+
+    if args.sock is None:
+        payload = _in_process_payload(args.repo)
+        if args.prom:
+            from hypermerge_tpu import telemetry
+
+            print(telemetry.prometheus_text(), end="")
+        elif args.json:
+            print(json.dumps(payload, sort_keys=True))
+        else:
+            print(format_rows({}, payload, 0.0))
+        return
+    if args.prom:
+        ap.error("--prom needs in-process mode (repo directory)")
+
+    client = IpcTelemetry(args.sock)
+    try:
+        prev = {}
+        while True:
+            try:
+                cur = client.poll()
+            except TimeoutError:
+                # backend busy (bulk cold open, big tick): skip the
+                # frame, keep watching
+                print("… backend busy, retrying", file=sys.stderr)
+                if args.once:
+                    sys.exit(2)
+                time.sleep(args.interval)
+                continue
+            if args.json:
+                print(json.dumps(cur, sort_keys=True), flush=True)
+            else:
+                dt = cur.get("time", 0) - prev.get("time", 0)
+                if not args.no_clear and prev:
+                    print("\x1b[2J\x1b[H", end="")
+                print(
+                    f"hm top — {args.sock} — "
+                    + time.strftime("%H:%M:%S"),
+                )
+                print(format_rows(prev, cur, dt), flush=True)
+            if args.once:
+                return
+            prev = cur
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    main()
