@@ -1,0 +1,32 @@
+"""Machine-checked concurrency invariants (ISSUE 10).
+
+Two halves over one rule set:
+
+- `hierarchy.py` — THE lock-hierarchy manifest (ranks, leaves,
+  no-block emission locks) plus the blocking-call and engine-entry
+  tables; `envvars.py` — the HM_* env-var registry.
+- `linter.py` — the static AST pass (`python tools/lint.py`, run in
+  tier-1 by tests/test_analysis.py); `lockdep.py` — the runtime
+  detector behind `HM_LOCKDEP=1` and the `make_lock`/`make_rlock`/
+  `make_condition` factories every package lock is created through.
+
+`suppressions.py` holds the (justified) exceptions.
+"""
+
+from .lockdep import (  # noqa: F401
+    blocking,
+    enable as enable_lockdep,
+    enabled as lockdep_enabled,
+    make_condition,
+    make_lock,
+    make_rlock,
+)
+
+__all__ = [
+    "blocking",
+    "enable_lockdep",
+    "lockdep_enabled",
+    "make_condition",
+    "make_lock",
+    "make_rlock",
+]

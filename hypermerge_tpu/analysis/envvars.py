@@ -1,0 +1,194 @@
+"""The HM_* environment-variable registry.
+
+Every `os.environ` read of an `HM_`-prefixed name anywhere in the
+package (plus tools/, scripts/, bench.py, __graft_entry__.py) must be
+declared here exactly once — the `env-registry` lint rule
+(analysis/linter.py) fails tier-1 on an undeclared read, on a registry
+entry nothing reads (stale), and on a registry entry missing from the
+README's env-var table. This is the one place a knob's default and
+meaning live; the README table is generated from the same data
+(`python tools/lint.py --env-table`).
+
+`default` is the literal fallback the reading site uses (None for
+presence-style flags where unset means off). Registering here is
+documentation, not parsing — call sites keep reading os.environ
+directly so hot paths stay allocation-free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+
+class EnvVar(NamedTuple):
+    name: str
+    default: Optional[str]  # None: presence-style flag, unset = off
+    doc: str
+
+
+REGISTRY: Tuple[EnvVar, ...] = (
+    # -- live apply engine ---------------------------------------------
+    EnvVar("HM_LIVE", "1", "Live apply engine on the incremental path "
+           "(0 = host OpSet twin)."),
+    EnvVar("HM_LIVE_TICK_MS", "2", "Debounce window of the live tick "
+           "(leading-edge pad of a burst)."),
+    EnvVar("HM_LIVE_TICK_MAX_MS", "25", "Adaptive ceiling of the live "
+           "tick window under sustained load."),
+    EnvVar("HM_LIVE_INC_BUDGET", "2000000", "Max cells (rows x lanes) a "
+           "small tick applies host-side before a catch-up dispatch."),
+    EnvVar("HM_LIVE_MAX_BYTES", "0", "Resident-bytes cap across adopted "
+           "docs' live columns; LRU demotes back to lazy (0 = unbounded)."),
+    EnvVar("HM_DEVICE_MIN_CELLS", "131072", "Below this many cells a "
+           "materialize runs host-side instead of a device dispatch."),
+    # -- bulk cold open / pipeline -------------------------------------
+    EnvVar("HM_BULK_SLAB", "4096", "Docs per bulk-load slab (the "
+           "streaming pipeline's unit of IO/pack/dispatch)."),
+    EnvVar("HM_PIPELINE", None, "Force the streaming pipeline on (1) or "
+           "off (0); unset = auto (on when the native pack drops the "
+           "GIL)."),
+    EnvVar("HM_PIPELINE_DEPTH", "2", "Bounded depth of each pipeline "
+           "stage queue."),
+    EnvVar("HM_FETCH_WORKERS", "4", "Summary-fetch workers (sized to "
+           "device count by the bulk loader)."),
+    EnvVar("HM_LOAD_THREADS", "8", "Parallel sidecar prefetch threads "
+           "for bulk document loads."),
+    EnvVar("HM_FAST_OPEN", "1", "Serve single-doc opens from the "
+           "columnar sidecar when possible (0 = full feed replay)."),
+    EnvVar("HM_SUMMARY_MEMO_MB", "256", "Byte-bounded LRU of per-doc "
+           "summary rows; clean docs skip pack+dispatch+fetch "
+           "(0 = disabled)."),
+    EnvVar("HM_ASYNC_SUMMARY_COPY", "1", "Overlap the device->host "
+           "summary copy with the next slab's dispatch."),
+    # -- mesh / multi-chip ---------------------------------------------
+    EnvVar("HM_MESH", "1", "Multi-device mesh programs (0 = single "
+           "device)."),
+    EnvVar("HM_SLAB_RR", "1", "Round-robin whole slabs across devices "
+           "(0 = sharded_full lockstep)."),
+    EnvVar("HM_RR_DEPTH", "2", "Per-device in-flight slab bound of the "
+           "round-robin scheduler."),
+    EnvVar("HM_RR_LEAST_LOADED", "0", "Shortest-queue-first slab "
+           "placement instead of strict round-robin."),
+    EnvVar("HM_ICI_PALLAS", "1", "Pallas async remote-copy ring for "
+           "collective gathers on real ICI (0 = lax.all_gather twin)."),
+    EnvVar("HM_COMPILE_CACHE", None, "Persistent XLA compile-cache "
+           "directory override (default ~/.cache/hypermerge_tpu/xla; "
+           "empty disables)."),
+    EnvVar("HM_COMPILE_CACHE_FORCE", "0", "Force-enable the persistent "
+           "XLA compile cache even on CPU."),
+    # -- storage --------------------------------------------------------
+    EnvVar("HM_SLAB", "1", "Columnar sidecars in one mmap'd corpus slab "
+           "file (0 = per-feed .cols2 files)."),
+    EnvVar("HM_SLAB_SLACK", "0.25", "Dead-byte fraction that triggers "
+           "slab compaction."),
+    EnvVar("HM_CKPT_TAIL", "64", "Sidecar tail length that triggers a "
+           "fresh column image (checkpoint) instead of a delta append."),
+    EnvVar("HM_BLOCK_CODEC", None, "Block codec override (zlib); unset "
+           "= raw."),
+    EnvVar("HM_FSYNC", "0", "Durability tier: 0 none, 1 group-fsync "
+           "window, 2 fsync per append."),
+    EnvVar("HM_FSYNC_MS", "25", "Group-fsync window for HM_FSYNC=1."),
+    EnvVar("HM_RECOVER", "1", "Whole-repo recovery-on-open after a "
+           "crash marker (0 = skip; tools/scrub.py --dry-run sets it)."),
+    EnvVar("HM_SIGN_INTERVAL", "1024", "Appends between persisted "
+           "merkle signature records (lazy signing)."),
+    EnvVar("HM_ALLOW_UNSIGNED_FEEDS", None, "=1 serves feeds with no "
+           "signature chain (tests/migration only)."),
+    EnvVar("HM_SPARSE_CAP", "1024", "Bound of the out-of-order "
+           "verified-block side buffer per feed."),
+    EnvVar("HM_SPARSE_WANTED_CAP", "8192", "Bound of the outstanding "
+           "sparse range-request set per feed (furthest-out shed "
+           "first)."),
+    EnvVar("HM_STORE_DEBOUNCE", "1", "Debounced clock/cursor sqlite "
+           "flusher (0 = write-through)."),
+    EnvVar("HM_STORE_FLUSH_MS", "5", "Window of the clock/cursor store "
+           "flusher."),
+    EnvVar("HM_CACHE_FLUSH_MS", "5", "Window of the deferred columnar "
+           "sidecar sync."),
+    EnvVar("HM_SYNC_FLUSH_MS", "2", "Window of the inbound-sync "
+           "application debouncer."),
+    EnvVar("HM_CLOCK_MIRROR", "1", "Device-resident clock mirror for "
+           "bulk union/dominated queries."),
+    # -- network --------------------------------------------------------
+    EnvVar("HM_GOSSIP_FLUSH_MS", "10", "Window of the cursor/clock "
+           "gossip broadcast debouncer."),
+    EnvVar("HM_GOSSIP_FRESH", "1", "Overlay pending store rows onto "
+           "gossip so it never advertises stale cursors."),
+    EnvVar("HM_REPL_CHUNK", "1024", "Blocks per replication data "
+           "frame."),
+    EnvVar("HM_REPL_CHUNK_BYTES", "8388608", "Byte bound per "
+           "replication data frame."),
+    EnvVar("HM_REPL_FLUSH_MS", "2", "Window of the replication live-"
+           "tail debouncer."),
+    EnvVar("HM_REPL_FLUSH_MAX_MS", "25", "Adaptive ceiling of the "
+           "replication flush window."),
+    EnvVar("HM_ANTIENTROPY_S", "30", "Period of the FeedLength "
+           "re-announce sweep (bounds staleness under frame loss; "
+           "0 = off)."),
+    EnvVar("HM_TCP_OUTBOX_MB", "64", "Per-connection outbound buffer "
+           "cap; exceeding it sheds the connection."),
+    EnvVar("HM_TCP_STALL_S", "10", "Writer-thread no-progress bound "
+           "before a connection is shed."),
+    EnvVar("HM_TCP_PLAINTEXT", None, "=1 disables the encrypted "
+           "session (tests only)."),
+    EnvVar("HM_NET_AUTH", "1", "Require peer identity proof at "
+           "accept/dial."),
+    EnvVar("HM_NET_PING_S", "15", "Keepalive probe period (0 = off)."),
+    EnvVar("HM_NET_PING_MISSES", "3", "Unanswered probes before a "
+           "half-open connection is shed."),
+    EnvVar("HM_DIAL_TIMEOUT_S", "10", "Bound on one dial+handshake "
+           "attempt."),
+    EnvVar("HM_REDIAL_BASE_MS", "250", "Base of the supervised-redial "
+           "full-jitter backoff."),
+    EnvVar("HM_REDIAL_MAX_S", "30", "Cap of the supervised-redial "
+           "backoff."),
+    EnvVar("HM_REDIAL_RESET_S", "1", "Connection must survive this "
+           "long before the backoff resets."),
+    EnvVar("HM_INFO_TIMEOUT_S", "20", "Reap connections whose Info "
+           "exchange never completes."),
+    EnvVar("HM_FAULT", None, "Deterministic network fault spec "
+           "(seed:events...) auto-applied to every swarm."),
+    EnvVar("HM_FILE_FETCH_TIMEOUT_S", "15", "Hyperfile range-fetch "
+           "timeout."),
+    # -- telemetry / analysis ------------------------------------------
+    EnvVar("HM_TRACE", None, "Span-trace output path (Chrome trace "
+           "JSON, written at exit)."),
+    EnvVar("HM_TRACE_RING", "65536", "Span ring capacity."),
+    EnvVar("HM_LOCKDEP", "0", "=1 instruments every factory-made lock: "
+           "records acquisition order, reports potential deadlock "
+           "cycles + held-across-blocking-call violations "
+           "(analysis/lockdep.py)."),
+    # -- native / tools -------------------------------------------------
+    EnvVar("HM_NATIVE_PACK", "1", "Native C++ pack kernel (0 = numpy "
+           "twin)."),
+    EnvVar("HM_NO_NATIVE", None, "Presence disables loading/building "
+           "the native library entirely."),
+    EnvVar("HM_DRYRUN_DOCS", "2048", "Docs for the graft-entry dryrun "
+           "corpus."),
+    EnvVar("HM_DRYRUN_OPS", "512", "Ops per doc for the graft-entry "
+           "dryrun corpus."),
+)
+
+BY_NAME: Dict[str, EnvVar] = {v.name: v for v in REGISTRY}
+
+
+def validate() -> None:
+    """Registry self-check: unique names, every entry documented."""
+    if len(BY_NAME) != len(REGISTRY):
+        raise ValueError("duplicate HM_* names in the env registry")
+    for v in REGISTRY:
+        if not v.name.startswith("HM_"):
+            raise ValueError(f"{v.name}: registry is for HM_* names")
+        if not v.doc.strip():
+            raise ValueError(f"{v.name}: missing description")
+
+
+def markdown_table() -> str:
+    """The README env-var table (tools/lint.py --env-table emits it)."""
+    lines = [
+        "| Variable | Default | Meaning |",
+        "| --- | --- | --- |",
+    ]
+    for v in REGISTRY:
+        default = "(unset)" if v.default is None else f"`{v.default}`"
+        lines.append(f"| `{v.name}` | {default} | {v.doc} |")
+    return "\n".join(lines)

@@ -1,0 +1,405 @@
+"""Runtime lockdep: opt-in instrumented locks + potential-deadlock
+detection (HM_LOCKDEP=1).
+
+Every lock in the package is created through `make_lock` /
+`make_rlock` / `make_condition` with a lock-class name declared in
+`analysis/hierarchy.py`. With lockdep OFF (the default) the factories
+return plain `threading` primitives — zero overhead, nothing imported
+beyond stdlib. With lockdep ON they return `DepLock` wrappers that
+record, per thread, the acquisition order of every tracked lock and
+maintain one process-global CLASS-level lock-order graph — the Linux
+lockdep idea: a single observed A-held-while-acquiring-B edge is
+enough to prove the order, so an inverted B->A acquisition on ANY
+later run (or the other branch of a race) is reported as a potential
+deadlock *without the deadlock ever firing*.
+
+Checks, all reported through `report()` / `assert_clean()`:
+
+- **cycles**: the class graph gains edge (A, B) whenever B is acquired
+  with A held; a path B -> ... -> A at insertion time is a potential
+  deadlock cycle (two threads interleaving the two chains can wedge).
+- **order**: acquiring a RANKED class while holding an equal-or-lower
+  ranked one inverts the declared hierarchy (hierarchy.RANKED).
+- **leaf**: acquiring ANY tracked lock while holding a leaf class.
+- **blocking**: `blocking(kind)` is called from the package's blocking
+  seams (io_fsync, sqlite commit, socket sendall, thread joins, queue
+  first-waits); reaching one with a no-block class held (the emission
+  locks) is a held-across-blocking-call violation.
+- **self-deadlock**: re-acquiring a held non-reentrant Lock.
+- **unknown-class**: a factory call naming a class missing from the
+  manifest (keeps hierarchy.py in sync with the code).
+
+The fault harnesses double as race drivers: tests/test_chaos.py and
+tests/test_live.py run their suites with lockdep enabled and assert a
+clean graph at teardown (see `tests/test_analysis.py` for the
+detector's own fixtures).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from .hierarchy import ALLOWED_EDGES, BY_NAME, LEAVES, NO_BLOCK, RANKED
+
+_MAX_REPORTS = 200  # bound memory on a pathological run
+
+_enabled = os.environ.get("HM_LOCKDEP", "0") == "1"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Flip instrumentation for locks created AFTER this call (tests:
+    enable before constructing the repos under test). Existing plain
+    locks stay untracked; existing DepLocks stay tracked."""
+    global _enabled
+    _enabled = on
+
+
+class _State:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        # class -> set of classes observed acquired while it was held
+        self.graph: Dict[str, set] = {}
+        self.edge_sites: Dict[Tuple[str, str], str] = {}
+        self.cycles: List[Dict[str, Any]] = []
+        self.violations: List[Dict[str, Any]] = []
+        self._seen_cycles: set = set()
+        self._seen_viol: set = set()
+
+
+_state = _State()
+_tls = threading.local()
+
+
+def _held() -> List[list]:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _site(skip: int = 3) -> str:
+    """Short code-site witness: innermost non-lockdep frames."""
+    frames = traceback.extract_stack()[:-skip]
+    tail = frames[-3:]
+    return " <- ".join(
+        f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+        for f in reversed(tail)
+    )
+
+
+def _record_violation(kind: str, key: tuple, msg: str) -> None:
+    with _state.lock:
+        if key in _state._seen_viol:
+            return
+        _state._seen_viol.add(key)
+        if len(_state.violations) < _MAX_REPORTS:
+            _state.violations.append(
+                {"kind": kind, "msg": msg, "site": _site(skip=4)}
+            )
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> ... -> dst in the class graph (caller holds
+    _state.lock)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _state.graph.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _add_edge(holder: str, acquired: str) -> None:
+    with _state.lock:
+        succ = _state.graph.setdefault(holder, set())
+        if acquired in succ:
+            return
+        # cycle check BEFORE inserting: a path acquired -> ... -> holder
+        # plus this new edge closes a loop
+        path = _find_path(acquired, holder)
+        succ.add(acquired)
+        site = _site(skip=4)
+        _state.edge_sites.setdefault((holder, acquired), site)
+        if path is not None:
+            key = frozenset(path)
+            if key not in _state._seen_cycles:
+                _state._seen_cycles.add(key)
+                if len(_state.cycles) < _MAX_REPORTS:
+                    _state.cycles.append(
+                        {
+                            "cycle": path + [acquired],
+                            "edge": (holder, acquired),
+                            "site": site,
+                            "prior_sites": [
+                                _state.edge_sites.get((a, b), "?")
+                                for a, b in zip(path, path[1:])
+                            ],
+                        }
+                    )
+
+
+class DepLock:
+    """Instrumented Lock/RLock with per-thread order tracking. Quacks
+    like the wrapped primitive, including the private Condition
+    protocol (`_is_owned`/`_release_save`/`_acquire_restore`) so
+    `threading.Condition(DepLock(...))` works."""
+
+    __slots__ = ("name", "_inner", "_reentrant")
+
+    def __init__(self, name: str, reentrant: bool) -> None:
+        self.name = name
+        self._reentrant = reentrant
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        if name not in BY_NAME:
+            _record_violation(
+                "unknown-class",
+                ("unknown", name),
+                f"lock class {name!r} is not declared in "
+                f"analysis/hierarchy.py",
+            )
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _pre_acquire(self, held: List[list]) -> None:
+        name = self.name
+        my_rank = RANKED.get(name)
+        for hname, hinst, _cnt in held:
+            if hinst is self:
+                continue
+            if (hname, name) in ALLOWED_EDGES:
+                continue
+            if (
+                hname in LEAVES
+                and name in RANKED
+                and name not in LEAVES
+            ):
+                # scoped to the ranked world: terminal unranked
+                # latches (native load-once, fault recorders) are
+                # pure sinks a leaf may touch — cycle detection still
+                # covers them
+                _record_violation(
+                    "leaf",
+                    ("leaf", hname, name),
+                    f"acquiring {name!r} while holding leaf lock "
+                    f"{hname!r}",
+                )
+            hr = RANKED.get(hname)
+            if my_rank is not None and hr is not None and hr >= my_rank:
+                _record_violation(
+                    "order",
+                    ("order", hname, name),
+                    f"acquiring {name!r} (rank {my_rank}) while "
+                    f"holding {hname!r} (rank {hr}) — inverts the "
+                    f"declared hierarchy",
+                )
+            _add_edge(hname, name)
+
+    # -- lock protocol -------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        held = _held()
+        entry = None
+        for e in held:
+            if e[1] is self:
+                entry = e
+                break
+        if entry is None:
+            self._pre_acquire(held)
+        elif not self._reentrant:
+            _record_violation(
+                "self-deadlock",
+                ("self", self.name),
+                f"re-acquiring held non-reentrant lock {self.name!r} "
+                f"on the same thread",
+            )
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if entry is not None and self._reentrant:
+                entry[2] += 1
+            else:
+                held.append([self.name, self, 1])
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] is self:
+                held[i][2] -= 1
+                if held[i][2] == 0:
+                    del held[i]
+                return
+
+    def locked(self) -> bool:
+        inner = getattr(self._inner, "locked", None)
+        return bool(inner()) if inner is not None else False
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<DepLock {self.name!r} {self._inner!r}>"
+
+    # -- Condition protocol --------------------------------------------
+
+    def _is_owned(self):
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        """Condition.wait: fully release (all recursion levels) and pop
+        our held entry — while waiting, the thread does NOT hold this
+        lock and must not contribute edges with it."""
+        count = 0
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] is self:
+                count = held[i][2]
+                del held[i]
+                break
+        inner = getattr(self._inner, "_release_save", None)
+        if inner is not None:
+            st = inner()
+        else:
+            self._inner.release()
+            st = None
+        return (st, count)
+
+    def _acquire_restore(self, saved) -> None:
+        st, count = saved
+        self._pre_acquire(_held())
+        inner = getattr(self._inner, "_acquire_restore", None)
+        if inner is not None:
+            inner(st)
+        else:
+            self._inner.acquire()
+        _held().append([self.name, self, max(count, 1)])
+
+
+# ---------------------------------------------------------------------------
+# factories — the ONE way the package creates locks (linter rule
+# raw-lock enforces this)
+
+
+def make_lock(name: str):
+    """A non-reentrant lock of the given manifest class."""
+    return DepLock(name, False) if _enabled else threading.Lock()
+
+
+def make_rlock(name: str):
+    """A re-entrant lock of the given manifest class."""
+    return DepLock(name, True) if _enabled else threading.RLock()
+
+
+def make_condition(name: str, lock=None):
+    """A Condition whose underlying lock is tracked under `name` (or
+    the caller's already-tracked `lock`)."""
+    if lock is None:
+        lock = make_rlock(name)
+    return threading.Condition(lock)
+
+
+# ---------------------------------------------------------------------------
+# blocking seams
+
+
+def blocking(kind: str, detail: str = "") -> None:
+    """Called from the package's blocking primitives (fsync, sqlite
+    commit, socket sendall, joins, first-waits). With lockdep on,
+    reaching one while holding a no-block class (the emission locks)
+    is recorded as a held-across-blocking-call violation."""
+    if not _enabled:
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for hname, _inst, _cnt in held:
+        if hname in NO_BLOCK:
+            _record_violation(
+                "blocking",
+                ("blocking", hname, kind),
+                f"blocking call {kind!r}{f' ({detail})' if detail else ''}"
+                f" while holding no-block lock {hname!r}",
+            )
+
+
+def held_classes() -> List[str]:
+    """Lock classes the CURRENT thread holds (debug aid)."""
+    return [e[0] for e in getattr(_tls, "held", ())]
+
+
+# ---------------------------------------------------------------------------
+# reporting
+
+
+def report() -> Dict[str, Any]:
+    """The global observation so far: every class-order edge with its
+    first witness site, potential cycles, and violations."""
+    with _state.lock:
+        pairs = sorted(
+            (a, b) for a, succ in _state.graph.items() for b in succ
+        )
+        edges = [
+            {"from": a, "to": b, "site": _state.edge_sites.get((a, b), "?")}
+            for a, b in pairs
+        ]
+        return {
+            "enabled": _enabled,
+            "edges": edges,
+            "cycles": [dict(c) for c in _state.cycles],
+            "violations": [dict(v) for v in _state.violations],
+        }
+
+
+def reset() -> None:
+    """Drop every observation (test isolation). Held-lock state of
+    live threads is intentionally kept — resetting mid-acquisition
+    would corrupt release bookkeeping."""
+    with _state.lock:
+        _state.graph.clear()
+        _state.edge_sites.clear()
+        _state.cycles.clear()
+        _state.violations.clear()
+        _state._seen_cycles.clear()
+        _state._seen_viol.clear()
+
+
+def assert_clean(
+    allow_kinds: Tuple[str, ...] = (), msg: str = ""
+) -> None:
+    """Raise AssertionError when any potential cycle or violation was
+    observed (tests call this at teardown). `allow_kinds` filters
+    violation kinds a specific suite tolerates."""
+    rep = report()
+    viol = [v for v in rep["violations"] if v["kind"] not in allow_kinds]
+    if rep["cycles"] or viol:
+        lines = [msg or "lockdep observations:"]
+        for c in rep["cycles"]:
+            lines.append(
+                f"  potential deadlock cycle: {' -> '.join(c['cycle'])}"
+                f"\n    closing edge at {c['site']}"
+            )
+        for v in viol:
+            lines.append(f"  {v['kind']}: {v['msg']}\n    at {v['site']}")
+        raise AssertionError("\n".join(lines))

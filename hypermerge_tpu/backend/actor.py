@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.lockdep import make_rlock
 from ..crdt.change import Change
 from ..storage import block as blockmod
 from ..storage.colcache import (
@@ -49,7 +50,7 @@ class Actor:
         # sync_cache() instead (the sidecar is derived data — columns()
         # catches up on demand, and blocks rebuild it after a crash)
         self._defer_cache = defer_cache
-        self._lock = threading.RLock()
+        self._lock = make_rlock("actor")
         # slot per feed block: _UNSET until decoded; None = corrupt.
         # Lazily sized — feed.length forces the block-log scan, which a
         # bulk cold open wants in its parallel prefetch, not in the

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.lockdep import make_rlock
 from ..crdt import clock as clockmod
 from ..crdt.change import Change, ChangeRequest
 from ..crdt.opset import OpSet
@@ -30,18 +31,20 @@ class DocBackend:
     ) -> None:
         self.id = doc_id
         self._notify = notify
-        self._lock = threading.RLock()
-        # serializes {compute patch -> push} emission pairs on the host
-        # path, so a Ready snapshot can never be pushed with a patch for
-        # a NEWER state already ahead of it in the frontend queue (a
-        # pending frontend drops pre-Ready patches). Only used when the
-        # live engine is OFF (HM_LIVE=0): with the engine on, the
-        # ENGINE lock is the single emission lock for every path
-        # (_emission_lock) — a second per-doc lock would invert against
-        # it when a frontend callback dispatched under one re-enters
-        # the repo and needs the other. Re-entrant for in-process
-        # frontends whose on_patch synchronously sends the next change.
-        self._emit_lock = threading.RLock()
+        self._lock = make_rlock("doc")
+        # `doc.emit` in the declared lock hierarchy
+        # (analysis/hierarchy.py): serializes {compute patch -> push}
+        # emission pairs on the host path, so a Ready snapshot can
+        # never be pushed with a patch for a NEWER state already ahead
+        # of it in the frontend queue (a pending frontend drops
+        # pre-Ready patches). Only used when the live engine is OFF
+        # (HM_LIVE=0): with the engine on, `live.engine` is the single
+        # emission lock for every path (_emission_lock) — a second
+        # per-doc lock would invert against it when a frontend callback
+        # dispatched under one re-enters the repo and needs the other.
+        # Re-entrant for in-process frontends whose on_patch
+        # synchronously sends the next change.
+        self._emit_lock = make_rlock("doc.emit")
         self.opset: Optional[OpSet] = opset
         # live apply engine (backend/live.py): lazy docs' incremental
         # changes batch through per-tick kernel dispatches instead of
@@ -265,8 +268,9 @@ class DocBackend:
         with self._lock:
             adopted = self._live_adopted
         if adopted and live is not None:
-            # engine lock ordering is engine -> doc: never call in with
-            # the doc lock held
+            # live.engine ranks above doc in the declared hierarchy
+            # (analysis/hierarchy.py): never call in with the doc lock
+            # held
             patch = live.snapshot_patch(self)
             if patch is not None:
                 return patch

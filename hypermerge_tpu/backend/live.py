@@ -55,6 +55,7 @@ from typing import Any, Dict, List, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
+from ..analysis.lockdep import make_lock, make_rlock
 from ..crdt.change import (
     HEAD,
     OBJ_TYPE_BY_MAKE,
@@ -333,7 +334,7 @@ def _decode_state(lv: LiveColumns, lanes) -> _DocState:
     return state
 
 
-_gc_pause_lock = threading.Lock()
+_gc_pause_lock = make_lock("live.gc")
 _gc_pause_depth = 0
 _gc_pause_was_on = False
 
@@ -635,14 +636,16 @@ class LiveApplyEngine:
 
     def __init__(self, backend) -> None:
         self._back = backend
-        self._lock = threading.RLock()
-        # the engine lock doubles as the GLOBAL emission lock while the
-        # engine is on: every {compute patch -> push} pair — engine
+        self._lock = make_rlock("live.engine")
+        # `live.engine` — the TOP of the declared lock hierarchy
+        # (analysis/hierarchy.py) and the GLOBAL emission lock while
+        # the engine is on: every {compute patch -> push} pair — engine
         # ticks, apply_local echoes, send_ready_atomic, and the host
         # path's DocBackend emissions — runs under this one re-entrant
         # lock, so frontend callbacks dispatched synchronously from a
         # push can re-enter the repo without a second lock to deadlock
-        # against.
+        # against. It is a no-block class: fsync/socket-send/sqlite
+        # commit under it are lint + lockdep violations.
         self._docs: Dict[str, _LiveDoc] = {}
         self._refused: Set[str] = set()  # adoption failed: host path
         # in-flight adoptions (doc_id -> gate). Builds run OUTSIDE the

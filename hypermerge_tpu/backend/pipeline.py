@@ -50,6 +50,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
+from ..analysis.lockdep import make_lock
 from .. import telemetry
 
 # process-wide pipeline series (telemetry registry): cumulative stage
@@ -171,7 +172,7 @@ class SlabPipeline:
         self.abort = threading.Event()
         self.error: Optional[BaseException] = None
         self.error_stage: Optional[str] = None
-        self._err_lock = threading.Lock()
+        self._err_lock = make_lock("pipeline.err")
         self.memo_hits: List[Any] = []
         self.fallbacks: List[Any] = []
 

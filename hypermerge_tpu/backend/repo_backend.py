@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.lockdep import make_lock, make_rlock
 from .. import msgs
 from ..crdt import clock as clockmod
 from ..crdt.change import Change, ChangeRequest
@@ -143,7 +144,7 @@ class RepoBackend:
             self.clocks.attach_mirror(self.id, DeviceClockMirror())
         self.docs: Dict[str, DocBackend] = {}
         self.actors: Dict[str, Actor] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("repo")
         self.to_frontend: Queue = Queue("backend:toFrontend")
         self._query_handlers: Dict[str, Callable] = {}
         self.network = None  # attached by setSwarm (net/, M7)
@@ -156,14 +157,14 @@ class RepoBackend:
         # device summary refs the materialization barrier fetches
         self._bulk_deferred_syncs: Optional[set] = None
         self._bulk_feed_rows: Optional[List] = None
-        self._bulk_mutex = threading.Lock()  # serializes bulk loads:
+        self._bulk_mutex = make_lock("repo.bulk")  # serializes bulk loads:
         # the deferral accumulators above are per-load state
         self._pending_summaries: List = []
         self._pending_memo: List = []
         # streaming-pipeline state: stage threads add stage timings
         # concurrently, and the async fetch worker of the most recent
         # load is joined by the materialization barrier
-        self._stats_lock = threading.Lock()
+        self._stats_lock = make_lock("repo.stats")
         self._fetch_ctx = None
         self._bulk_t0: Optional[float] = None
         self._rr_cached = False  # round-robin scheduler, built lazily
@@ -322,9 +323,12 @@ class RepoBackend:
             if existing._announced:
                 # a (re)opened frontend needs the Ready snapshot again.
                 # OUTSIDE self._lock: the snapshot takes the live-engine
-                # lock, and engine->repo is the established lock order
-                # (adoption opens actors under self._lock) — holding
-                # repo->engine here would deadlock against a tick.
+                # lock, and live.engine ranks ABOVE repo in the declared
+                # hierarchy (analysis/hierarchy.py; adoption opens
+                # actors under self._lock) — holding repo->engine here
+                # would deadlock against a tick. The lint rule
+                # `lock-order` flags engine entrypoints called under
+                # repo/doc/store locks.
                 self._send_ready(existing)
             return existing
         try:

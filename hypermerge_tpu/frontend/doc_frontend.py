@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..analysis.lockdep import make_rlock
 from ..crdt.frontend_state import FrontendDoc
 from ..crdt.patch import Patch
 from ..utils.debug import bench, log
@@ -32,7 +33,7 @@ class DocFrontend:
         self.history = 0
         self._handles: List[Handle] = []
         self._change_queue: List[tuple] = []
-        self._lock = threading.RLock()
+        self._lock = make_rlock("front.doc")
         # lazy-ready (bulk open): the backend has this doc materialized
         # but the Ready (with its snapshot patch) is fetched only when a
         # reader actually wants the value — a 10k-doc open_many must not
@@ -101,10 +102,21 @@ class DocFrontend:
         # else the fn would build ops against a blank document
         self.poke()
         with self._lock:
-            if self.mode == "pending" or self.actor_id is None:
+            needs_actor = self.mode == "pending" or self.actor_id is None
+            if needs_actor:
                 self._change_queue.append((fn, message))
-                self._repo.needs_actor(self.doc_id)
-                return
+        if needs_actor:
+            # OUTSIDE self._lock: pushing to the backend queue can make
+            # THIS thread the drainer of whatever is buffered there —
+            # including another change's Request, which takes the
+            # engine lock — while a tick holding the engine lock is
+            # pushing a patch back into this doc's on_patch
+            # (front.doc <-> live.engine AB/BA; caught by the first
+            # HM_LOCKDEP=1 run over this tree). Queue callbacks for one
+            # queue never run concurrently, so the append above is
+            # already safely ordered.
+            self._repo.needs_actor(self.doc_id)
+            return
         self._run_change(fn, message)
 
     def _run_change(self, fn: Callable, message: str) -> None:

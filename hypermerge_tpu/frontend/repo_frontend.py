@@ -10,6 +10,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional
 
+from ..analysis.lockdep import make_rlock
 from .. import msgs
 from ..crdt import clock as clockmod
 from ..crdt.change import ChangeRequest
@@ -34,7 +35,7 @@ class RepoFrontend:
         self.docs: Dict[str, DocFrontend] = {}
         self._queries: Dict[int, Callable[[Any], None]] = {}
         self._next_query = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("front.repo")
         self.files = None  # FileServerClient, attached when files start
 
     # ------------------------------------------------------------------

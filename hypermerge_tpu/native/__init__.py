@@ -20,6 +20,8 @@ import subprocess
 import threading
 from typing import Optional
 
+from ..analysis.lockdep import make_lock
+
 CAP_SODIUM = 1
 CAP_BROTLI = 2
 CAP_ZLIB = 4
@@ -27,7 +29,7 @@ CAP_ZLIB = 4
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libhm_native.so")
 
-_lock = threading.Lock()
+_lock = make_lock("native.load")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

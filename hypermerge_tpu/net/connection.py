@@ -20,6 +20,7 @@ import uuid
 from collections import deque
 from typing import Any, Callable, Dict, Optional
 
+from ..analysis.lockdep import make_lock
 from ..utils.queue import Queue
 from .duplex import Duplex
 
@@ -47,7 +48,7 @@ class PeerConnection:
         self._channels: Dict[str, Channel] = {}
         self.is_open = True
         self._close_listeners = []
-        self._close_lock = threading.Lock()
+        self._close_lock = make_lock("net.conn")
         self.network_bus = self.open_channel(NETWORK_BUS)
         duplex.on_message(self._on_raw)
         duplex.on_close(self._on_transport_close)

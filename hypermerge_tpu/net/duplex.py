@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import threading
 from collections import deque
+
+from ..analysis.lockdep import make_rlock
 from typing import Any, Callable, Optional
 
 
@@ -76,7 +78,7 @@ class _Trampoline:
 
     def __init__(self) -> None:
         self._queue: deque = deque()
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.duplex")
         self._pumping = False
 
     def defer(self, fn: Callable[[], None]) -> None:

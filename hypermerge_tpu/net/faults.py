@@ -42,6 +42,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..analysis.lockdep import make_condition, make_lock
 from ..utils.debug import log
 from .swarm import ConnectionDetails, Swarm
 
@@ -90,7 +91,7 @@ class FaultPlan:
                 raise ValueError(f"unknown fault event {ev!r}")
         self._tx_rng = random.Random((seed << 1) ^ 0xFA17)
         self._rx_rng = random.Random((seed << 1) | 1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("net.fault.plan")
         self.tick = 0
         self._next_event = 0
         # link state (event-driven)
@@ -198,7 +199,7 @@ class _DelayLine:
 
     def __init__(self, deliver: Callable[[Any, int], None]) -> None:
         self._deliver = deliver
-        self._cv = threading.Condition()
+        self._cv = make_condition("net.fault.delay")
         self._q: deque = deque()  # (due_monotonic, msg, copies)
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -361,7 +362,7 @@ class FaultSwarm(Swarm):
         self.inner = inner
         self.plan = plan
         self.stats = _new_stats()
-        self._lock = threading.Lock()
+        self._lock = make_lock("net.fault.swarm")
         self._live: List[FaultDuplex] = []
         self._cb: Optional[Callable] = None
         self._ticker: Optional[threading.Thread] = None

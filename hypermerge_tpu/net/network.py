@@ -14,6 +14,7 @@ import os
 import threading
 from typing import Any, Dict, Optional, Set
 
+from ..analysis.lockdep import make_rlock
 from .. import msgs
 from ..crdt import clock as clockmod
 from ..utils.debug import log
@@ -36,7 +37,7 @@ class Network:
         self.pending_joins: Set[str] = set()
         self.peers: Dict[str, NetworkPeer] = {}
         self.closed_connection_count = 0
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.network")
         self.replication = ReplicationManager(
             backend.feeds, self._on_feed_discovery
         )

@@ -18,6 +18,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Optional
 
+from ..analysis.lockdep import make_lock
 from .. import msgs
 from ..utils.debug import log
 from .connection import PeerConnection
@@ -39,7 +40,7 @@ class NetworkPeer:
         self._pending: List[PeerConnection] = []
         # guards _pending: mutated from accept/supervisor threads
         # (add_connection) AND reader threads (close-driven prune)
-        self._plock = threading.Lock()
+        self._plock = make_lock("net.peer")
 
     @property
     def we_have_authority(self) -> bool:

@@ -60,6 +60,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
+from ..analysis.lockdep import make_rlock
 from ..storage.feed import Feed, FeedStore
 from ..storage.integrity import allow_unsigned, capability
 from ..utils.debug import log
@@ -105,7 +106,7 @@ class ReplicationManager:
     ) -> None:
         self.feeds = feeds
         self._on_discovery = on_discovery
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.repl")
         self._peers: Set[NetworkPeer] = set()
         # discovery_id -> peers replicating it with us. Membership
         # requires CAPABILITY verification: a peer only enters (and so

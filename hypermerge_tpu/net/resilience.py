@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..analysis.lockdep import make_rlock
 from ..utils.debug import log
 
 
@@ -133,7 +134,7 @@ class SessionSupervisor:
         self._deliver = deliver
         self._banned = banned if banned is not None else lambda a: False
         self._on_status = on_status
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.sup")
         self._sessions: Dict[Any, Session] = {}
         self._stopped = False
         # registry-backed (one labeled series per supervisor); the

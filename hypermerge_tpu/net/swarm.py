@@ -14,6 +14,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ..analysis.lockdep import make_rlock
 from .duplex import Duplex, duplex_pair
 
 
@@ -89,7 +90,7 @@ class LoopbackHub:
     discovery (reference JoinOptions asymmetry)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.swarm")
         self._members: Dict[
             str, List[Tuple["LoopbackSwarm", JoinOptions]]
         ] = {}

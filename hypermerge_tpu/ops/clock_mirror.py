@@ -25,6 +25,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
+
 import numpy as np
 
 INT32_INF = 2**31 - 1
@@ -70,7 +72,7 @@ class DeviceClockMirror:
     def __init__(
         self, capacity_docs: int = 1024, capacity_actors: int = 64
     ) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_rlock("ops.clock_mirror")
         self.doc_index: Dict[str, int] = {}
         self.actor_index: Dict[str, int] = {}
         self._actors: List[str] = []

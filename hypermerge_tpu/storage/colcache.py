@@ -58,6 +58,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis.lockdep import make_rlock
 from ..crdt.change import HEAD, ROOT, Action, Change
 from .faults import io_fsync, io_open, io_remove, io_replace
 
@@ -927,7 +928,7 @@ class FeedColumnCache:
 
     def __init__(self, storage, writer: str) -> None:
         self._storage = storage
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.colcache")
         self.writer = writer
         self._loaded = False  # storage read is deferred: a bulk cold
         # start creates thousands of caches serially but loads them in

@@ -40,6 +40,7 @@ import os
 import threading
 from typing import Optional, Set
 
+from ..analysis.lockdep import make_lock
 from ..utils.debug import log
 from .. import telemetry
 
@@ -70,7 +71,7 @@ class DurabilityManager:
     and tier 2 never pay for it)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.durability")
         self._dirty: Set = set()
         self._flusher = None
         self._closed = False

@@ -54,6 +54,9 @@ import sqlite3
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..analysis import lockdep
+from ..analysis.lockdep import make_lock
+
 WRITE = "write"
 APPEND = "append"
 TRUNCATE = "truncate"
@@ -101,7 +104,7 @@ class DiskFaultPlan:
         self.errnos = errnos
         self.after = after
         self.path_filter = path_filter
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.fault.plan")
         self._rngs: Dict[str, random.Random] = {}
         self._ops: Dict[str, int] = {}
         self.stats: Dict[str, int] = {
@@ -172,7 +175,7 @@ class CrashRecorder:
 
     def __init__(self, root: str) -> None:
         self.root = os.path.abspath(root)
-        self._lock = threading.Lock()
+        self._lock = make_lock("store.fault.recorder")
         self.events: List[Tuple] = []
         self._db_pending: Dict[str, List[Tuple]] = {}
 
@@ -412,7 +415,7 @@ class _Active:
 
 
 _active: Optional[_Active] = None
-_active_lock = threading.Lock()
+_active_lock = make_lock("store.fault.active")
 
 
 @contextlib.contextmanager
@@ -550,6 +553,7 @@ def io_open(path: str, mode: str = "rb"):
 def io_fsync(fh) -> None:
     """fsync through the harness: may raise EIO, may LIE (succeed
     without durability — visible only to the power-cut replay)."""
+    lockdep.blocking("fsync", getattr(fh, "path", "") or "")
     a = _active
     if a is None:
         os.fsync(fh.fileno())

@@ -22,6 +22,7 @@ import struct
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..analysis.lockdep import make_rlock
 from ..utils import keys as keymod
 from ..utils.debug import log
 from ..utils.ids import DiscoveryId, get_or_create
@@ -310,7 +311,7 @@ class Feed:
         self._discovery_id: Optional[str] = None  # lazy: ~40us of
         # base58+blake2b per feed adds up over a 10k-feed cold open
         self._storage = storage
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.feed")
         self._append_listeners: List[Callable[[int, bytes], None]] = []
         # chunk-granularity listeners: cb(start, end) once per extension
         # (a verified multi-block chunk fires ONE of these but one
@@ -591,7 +592,7 @@ class FeedStore:
         self._feeds: Dict[str, Feed] = {}
         self._by_discovery: Dict[str, str] = {}
         self._discovery_pending: List[Feed] = []  # ids computed lazily
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.feed_store")
         self.feed_q: Queue = Queue("feedstore")
 
     def create(self, pair: keymod.KeyPair) -> Feed:

@@ -45,6 +45,7 @@ import struct
 import threading
 from typing import List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
 from ..utils import crypto
 from ..utils import keys as keymod
 from ..utils.debug import log
@@ -205,7 +206,7 @@ class FeedIntegrity:
     def __init__(self, store, public_key: str) -> None:
         self._store = store
         self.public_key = public_key
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.integrity")
         self._records: Optional[List[Tuple[int, bytes, bytes]]] = None
         self._peaks: Optional[Peaks] = None
         self._leaves: List[bytes] = []
@@ -467,8 +468,9 @@ class FeedIntegrity:
             ctx = self._proof_cache.get(length)
             if ctx is not None:
                 return ctx
-        # leaves snapshot outside the integrity lock (same lock-order
-        # rule as _ensure_leaves: never integrity -> feed)
+        # leaves snapshot outside the integrity lock: store.integrity
+        # is a LEAF class in the lock hierarchy (analysis/hierarchy.py
+        # — same rule as _ensure_leaves: never integrity -> feed)
         leaves = self._ensure_leaves(feed, length)
         ctx = build_proof_ctx(leaves, length)
         with self._lock:

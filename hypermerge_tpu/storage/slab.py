@@ -46,6 +46,7 @@ import struct
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
 from ..utils.debug import log
 from .faults import io_fsync, io_open, io_remove, io_replace
 
@@ -70,7 +71,7 @@ class CorpusSlab:
     def __init__(self, path: str) -> None:
         self.path = path
         self.idx_path = path + ".idx"
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.slab")
         self._loaded = False
         # name -> live extents [(kind, payload_off, payload_len)]:
         # an image resets the list, records append, a tombstone clears

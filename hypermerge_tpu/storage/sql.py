@@ -23,6 +23,8 @@ import contextlib
 import sqlite3
 import threading
 
+from ..analysis import lockdep
+from ..analysis.lockdep import make_rlock
 from .faults import active_recorder
 
 _SCHEMA = """
@@ -59,7 +61,7 @@ class SqlDatabase:
     def __init__(self, path: str = ":memory:") -> None:
         self.path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.sql")
         self._defer_commit = 0
         with self._lock:
             self._conn.executescript(_SCHEMA)
@@ -75,6 +77,10 @@ class SqlDatabase:
             rec.db_stmt(self.path, kind, sql, params)
 
     def _record_commit(self) -> None:
+        # every commit call site pairs with this: the lockdep blocking
+        # seam for sqlite (a commit under an emission lock would stall
+        # every doc's patch pushes on disk latency)
+        lockdep.blocking("sqlite_commit", self.path)
         if self.path == ":memory:":
             return
         rec = active_recorder()

@@ -18,6 +18,7 @@ import math
 import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
 from ..crdt import clock as clockmod
 from ..utils import keys as keymod
 from .sql import SqlDatabase
@@ -231,28 +232,59 @@ class CursorStore:
 
     def __init__(self, db: SqlDatabase) -> None:
         self.db = db
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.cursors")
         # repo_id -> doc_id -> {actor: seq}; repo_id -> actor -> docs
         self._mem: Dict[str, Dict[str, Dict[str, int]]] = {}
         self._by_actor: Dict[str, Dict[str, Dict[str, None]]] = {}
+        self._hydrated: set = set()  # repo_ids with SQLite rows merged
+        # bumped by delete_doc: deletion is NOT monotonic, so a
+        # hydration snapshot taken before a racing delete must be
+        # thrown away and re-queried (see _ensure_hydrated)
+        self._del_gen: Dict[str, int] = {}
 
     def _repo(self, repo_id: str) -> Dict[str, Dict[str, int]]:
-        """The repo's mirror, hydrating from SQLite on first touch.
-        Caller holds self._lock."""
+        """The repo's mirror dicts (created empty on demand). Caller
+        holds self._lock. Hydration from SQLite happens ONLY in
+        _ensure_hydrated — never here, never under the mirror lock."""
         mem = self._mem.get(repo_id)
         if mem is None:
-            mem = {}
-            by_actor: Dict[str, Dict[str, None]] = {}
-            for doc_id, actor, seq in self.db.query(
+            mem = self._mem[repo_id] = {}
+            self._by_actor[repo_id] = {}
+        return mem
+
+    def _ensure_hydrated(self, repo_id: str) -> None:
+        """Merge the repo's SQLite rows into the mirror, once. The
+        query runs with NO mirror lock held: the write batches absorb
+        into the mirror from inside `db.bulk()` (sql lock HELD), so
+        the declared order is store.sql -> store.cursors
+        (analysis/hierarchy.py) — hydrating under the mirror lock was
+        the other half of a real sql<->cursors AB/BA deadlock the
+        first HM_LOCKDEP=1 run over this tree caught (bulk-load /
+        store-flush thread vs a replication cursor lookup).
+
+        Upsert races are safe by monotonicity: a row committed after
+        our query was also write-through absorbed by its writer, and a
+        concurrent hydration merging the same snapshot is idempotent
+        (max-wins). DELETION is not monotonic — a delete_doc landing
+        between our query and our merge would be resurrected by the
+        stale snapshot — so delete_doc bumps a per-repo generation and
+        we re-query whenever it moved."""
+        while repo_id not in self._hydrated:  # membership: GIL-atomic
+            with self._lock:
+                gen = self._del_gen.get(repo_id, 0)
+            rows = self.db.query(
                 "SELECT doc_id, actor_id, seq FROM cursors "
                 "WHERE repo_id=?",
                 (repo_id,),
-            ):
-                mem.setdefault(doc_id, {})[actor] = seq
-                by_actor.setdefault(actor, {})[doc_id] = None
-            self._mem[repo_id] = mem
-            self._by_actor[repo_id] = by_actor
-        return mem
+            )
+            with self._lock:
+                if repo_id in self._hydrated:
+                    return
+                if self._del_gen.get(repo_id, 0) != gen:
+                    continue  # a delete raced the query: snapshot stale
+                for doc_id, actor, seq in rows:
+                    self._absorb(repo_id, doc_id, actor, seq)
+                self._hydrated.add(repo_id)
 
     def _absorb(
         self, repo_id: str, doc_id: str, actor: str, seq: int
@@ -265,16 +297,19 @@ class CursorStore:
         self._by_actor[repo_id].setdefault(actor, {})[doc_id] = None
 
     def get(self, repo_id: str, doc_id: str) -> clockmod.Clock:
+        self._ensure_hydrated(repo_id)
         with self._lock:
             return dict(self._repo(repo_id).get(doc_id, {}))
 
     def entry(self, repo_id: str, doc_id: str, actor_id: str) -> int:
+        self._ensure_hydrated(repo_id)
         with self._lock:
             return self._repo(repo_id).get(doc_id, {}).get(actor_id, 0)
 
     def update(
         self, repo_id: str, doc_id: str, clock: clockmod.Clock
     ) -> clockmod.Clock:
+        self._ensure_hydrated(repo_id)  # the read-back below merges
         self.db.executemany(
             "INSERT INTO cursors (repo_id, doc_id, actor_id, seq) "
             "VALUES (?,?,?,?) "
@@ -332,11 +367,13 @@ class CursorStore:
     ) -> Dict[str, clockmod.Clock]:
         """Cursors for many docs in one pass over the mirror."""
         ids = list(doc_ids)
+        self._ensure_hydrated(repo_id)
         with self._lock:
             mem = self._repo(repo_id)
             return {d: dict(mem.get(d, {})) for d in ids}
 
     def docs_with_actor(self, repo_id: str, actor_id: str) -> List[str]:
+        self._ensure_hydrated(repo_id)
         with self._lock:
             self._repo(repo_id)
             return list(self._by_actor[repo_id].get(actor_id, ()))
@@ -350,6 +387,9 @@ class CursorStore:
             (repo_id, doc_id),
         )
         with self._lock:
+            # invalidate in-flight hydrations: a snapshot queried
+            # before this delete must not merge the doc back in
+            self._del_gen[repo_id] = self._del_gen.get(repo_id, 0) + 1
             if repo_id in self._mem:
                 self._mem[repo_id].pop(doc_id, None)
                 for docs in self._by_actor[repo_id].values():

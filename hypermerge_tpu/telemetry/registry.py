@@ -34,7 +34,28 @@ from bisect import bisect_left
 from threading import get_ident
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from ..analysis import hierarchy, lockdep
+from ..analysis.lockdep import make_lock
+
 LabelsT = Tuple[Tuple[str, str], ...]
+
+# the dotted `subsystem.metric` convention (telemetry/__init__.py):
+# tools/top.py groups per-subsystem rates by the prefix, so a flat or
+# oddly-cased name silently falls out of every view. Checked statically
+# by the `telemetry-name` lint rule where the name is a literal, and
+# here at creation time when runtime lockdep is on (HM_LOCKDEP=1) for
+# the dynamically-built names the linter cannot see. The pattern is
+# shared with the linter (analysis/hierarchy.py) so the two halves of
+# the rule cannot drift.
+_NAME_RE = hierarchy.TELEMETRY_NAME_RE
+
+
+def _check_name(name: str) -> None:
+    if lockdep.enabled() and not _NAME_RE.match(name):
+        raise ValueError(
+            f"telemetry series {name!r} breaks the dotted "
+            f"`subsystem.metric` naming convention"
+        )
 
 
 class _Cell:
@@ -59,7 +80,7 @@ class Counter:
         self.name = name
         self.labels = labels
         self._shards: Dict[int, _Cell] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.shard")
 
     def add(self, v: float = 1) -> None:
         ident = get_ident()
@@ -91,7 +112,7 @@ class Gauge:
         self.name = name
         self.labels = labels
         self._v: float = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.shard")
 
     def set(self, v: float) -> None:
         self._v = v
@@ -139,7 +160,7 @@ class Histogram:
         if not self.buckets:
             raise ValueError("histogram needs at least one bucket")
         self._shards: Dict[int, _HistCell] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.shard")
 
     def observe(self, v: float) -> None:
         ident = get_ident()
@@ -176,7 +197,7 @@ class MetricsRegistry:
     or re-resolve by name (tools do)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("telemetry.table")
         self._series: Dict[Tuple[str, str, LabelsT], Any] = {}
 
     # -- get-or-create -------------------------------------------------
@@ -193,6 +214,7 @@ class MetricsRegistry:
         buckets: Iterable[float] = DEFAULT_TIME_BUCKETS_S,
         **labels: Any,
     ) -> Histogram:
+        _check_name(name)
         key = ("histogram", name, _labels_key(labels))
         with self._lock:
             m = self._series.get(key)
@@ -203,6 +225,7 @@ class MetricsRegistry:
             return m
 
     def _get(self, kind: str, cls, name: str, labels: Dict) -> Any:
+        _check_name(name)
         key = (kind, name, _labels_key(labels))
         with self._lock:
             m = self._series.get(key)

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ..analysis import lockdep
+from ..analysis.lockdep import make_rlock
 from .debug import log
 
 
@@ -46,7 +48,7 @@ class Debouncer:
         # fns whose cost amortizes over batch size (the live tick);
         # wrong for pure rate-limiters (gossip).
         self._eager = eager
-        self._lock = threading.RLock()
+        self._lock = make_rlock("util.debounce")
         self._cv = threading.Condition(self._lock)
         self._keys: Dict = {}
         self._inflight: Dict = {}
@@ -85,6 +87,7 @@ class Debouncer:
         False if the timeout expired with work still in flight, so
         callers whose next step assumes durability (destroy deleting
         rows a late flush would resurrect) can act on the failure."""
+        lockdep.blocking("flush_wait", self._name)
         deadline = time.monotonic() + timeout
         with self._cv:
             while self._keys or self._flushing:
@@ -97,6 +100,7 @@ class Debouncer:
     def close(self, timeout: float = 5.0) -> None:
         """Stop accepting marks and drain: pending keys are flushed
         before the flusher thread exits."""
+        lockdep.blocking("thread_join", self._name)
         with self._cv:
             self._closed = True
             self._cv.notify_all()

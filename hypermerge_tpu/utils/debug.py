@@ -19,6 +19,8 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, Tuple
 
+from ..analysis.lockdep import make_lock
+
 # Patterns re-resolve at CALL time, not import time: a daemon can
 # toggle namespaces without a restart, either programmatically
 # (set_patterns) or by mutating os.environ["DEBUG"] — the env string
@@ -27,7 +29,7 @@ from typing import Any, Callable, Dict, Iterator, Tuple
 _env_cache: str = ""
 _env_patterns: list = []
 _override: "list | None" = None
-_patterns_lock = threading.Lock()
+_patterns_lock = make_lock("util.debug")
 
 
 def _parse(spec: str) -> list:
@@ -85,7 +87,7 @@ def trace(label: str) -> Callable[..., Any]:
 # -- timers ----------------------------------------------------------------
 
 _TIMINGS: Dict[str, Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
-_TIMINGS_LOCK = threading.Lock()
+_TIMINGS_LOCK = make_lock("util.debug")
 
 
 @contextmanager

@@ -17,6 +17,8 @@ import threading
 from collections import deque
 from typing import Callable, Deque, Generic, List, Optional, TypeVar
 
+from ..analysis import lockdep
+from ..analysis.lockdep import make_rlock
 from .debug import log
 
 T = TypeVar("T")
@@ -27,7 +29,7 @@ class Queue(Generic[T]):
         self.name = name
         self._buffer: Deque[T] = deque()
         self._subscription: Optional[Callable[[T], None]] = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("util.queue")
         self._draining = False
         self._first_waiters: List[threading.Event] = []
         self._has_first = False
@@ -71,6 +73,7 @@ class Queue(Generic[T]):
         """Block until the first item is available and return it (does not
         consume — mirrors the promise-shaped `first()` of the reference,
         src/Queue.ts:16-20)."""
+        lockdep.blocking("queue_first", self.name)
         ev = threading.Event()
         with self._lock:
             if self._has_first:

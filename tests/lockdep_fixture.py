@@ -1,0 +1,46 @@
+"""Shared lockdep-on-for-this-module fixture (test_chaos, test_live).
+
+The fault harness and the live twin suites double as RACE DRIVERS:
+running them with HM_LOCKDEP=1 makes every lock they churn through an
+instrumented one, and the module teardown asserts the observed global
+lock-order graph is clean — no potential deadlock cycle, no declared-
+hierarchy inversion, no leaf violation — even though no deadlock fired.
+
+`blocking` violations are excluded from the assertion: the live path's
+feed-append + clock-row commit inside the engine lock is the KNOWN,
+ROADMAP-documented emission-serialization cost (the per-doc emission
+lock split is the successor work); lockdep still records them so
+`report()` shows the debt.
+"""
+
+import os
+
+import pytest
+
+from hypermerge_tpu.analysis import lockdep
+
+
+def lockdep_suite():
+    """Module-scoped autouse fixture factory: enable lockdep for every
+    lock created while this module's tests run, and assert a clean
+    graph at teardown."""
+
+    @pytest.fixture(autouse=True, scope="module")
+    def _lockdep_suite():
+        was_env = os.environ.get("HM_LOCKDEP")
+        was = lockdep.enabled()
+        os.environ["HM_LOCKDEP"] = "1"
+        lockdep.enable(True)
+        lockdep.reset()
+        yield
+        lockdep.enable(was)
+        if was_env is None:
+            os.environ.pop("HM_LOCKDEP", None)
+        else:
+            os.environ["HM_LOCKDEP"] = was_env
+        lockdep.assert_clean(
+            allow_kinds=("blocking",),
+            msg="the suite's lock churn surfaced lockdep findings:",
+        )
+
+    return _lockdep_suite

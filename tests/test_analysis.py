@@ -1,0 +1,499 @@
+"""Invariant linter + runtime lockdep (hypermerge_tpu/analysis/).
+
+Three layers:
+- the tier-1 gate: `lint_repo()` over the real tree must report ZERO
+  unsuppressed violations (exactly what `python tools/lint.py` exits
+  nonzero on);
+- per-rule fixtures: each lint rule on small violating + conforming
+  snippets, so a rule regression fails with a readable diff instead of
+  "the tree got dirty";
+- the runtime detector: an A->B / B->A potential cycle on two threads
+  is REPORTED without deadlocking, rank/leaf/blocking violations are
+  recorded, and the factories stay plain threading primitives while
+  lockdep is off.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from hypermerge_tpu.analysis import envvars, hierarchy, linter, lockdep
+from hypermerge_tpu.analysis import suppressions as suppmod
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PKG_PATH = "hypermerge_tpu/_fixture.py"
+
+
+def _rules(viols, rule=None, suppressed=False):
+    return [
+        v
+        for v in viols
+        if (rule is None or v.rule == rule)
+        and v.suppressed == suppressed
+    ]
+
+
+# ---------------------------------------------------------------------------
+# manifests
+
+
+def test_manifests_validate():
+    hierarchy.validate()
+    envvars.validate()
+
+
+def test_hierarchy_core_order():
+    """The documented core order is what the manifest declares."""
+    r = hierarchy.RANKED
+    assert r["repo.bulk"] < r["live.engine"] < r["doc.emit"] < r["doc"]
+    assert r["doc"] < r["repo"] < r["actor"] < r["store.feed"]
+    assert r["store.sql"] < r["store.cursors"]  # bulk batches absorb
+    # into the mirror with the sql lock held (stores.py)
+    assert "store.integrity" in hierarchy.LEAVES
+    assert "util.debug" in hierarchy.LEAVES
+    assert hierarchy.NO_BLOCK == {"live.engine", "doc.emit"}
+
+
+# ---------------------------------------------------------------------------
+# THE tier-1 gate
+
+
+def test_tree_is_clean():
+    """Zero unsuppressed violations over the real tree — the same
+    check `python tools/lint.py` runs in CI."""
+    viols = linter.unsuppressed(linter.lint_repo(ROOT))
+    assert viols == [], "\n" + "\n".join(v.format() for v in viols)
+
+
+# ---------------------------------------------------------------------------
+# lint rule fixtures
+
+
+FIXTURE_LOCKS = """
+from hypermerge_tpu.analysis.lockdep import make_rlock
+
+class Engine:
+    def __init__(self):
+        self._lock = make_rlock("live.engine")
+
+class Store:
+    def __init__(self):
+        self._slock = make_rlock("store.feed")
+"""
+
+
+def test_lock_order_rule():
+    bad = FIXTURE_LOCKS + """
+class User:
+    def __init__(self, engine, store):
+        self.e, self.s = engine, store
+    def broken(self):
+        with self.s._slock:
+            with self.e._lock:
+                pass
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "lock-order")
+    assert len(viols) == 1 and "inverts" in viols[0].msg
+    good = FIXTURE_LOCKS + """
+class User:
+    def __init__(self, engine, store):
+        self.e, self.s = engine, store
+    def fine(self):
+        with self.e._lock:
+            with self.s._slock:
+                pass
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "lock-order") == []
+
+
+def test_lock_order_leaf_rule():
+    bad = """
+from hypermerge_tpu.analysis.lockdep import make_rlock
+
+class I:
+    def __init__(self):
+        self._ilock = make_rlock("store.integrity")
+        self._flock = make_rlock("store.feed")
+    def broken(self):
+        with self._ilock:
+            with self._flock:
+                pass
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "lock-order")
+    assert len(viols) == 1 and "leaf" in viols[0].msg
+
+
+def test_engine_entrypoint_rule():
+    bad = FIXTURE_LOCKS + """
+class R:
+    def __init__(self, live):
+        self._rlock = make_rlock("repo")
+        self.live = live
+    def broken(self, doc, push):
+        with self._rlock:
+            self.live.send_ready_atomic(doc, push, doc.snapshot_patch)
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "lock-order")
+    assert len(viols) == 1 and "outermost" in viols[0].msg
+    good = bad.replace(
+        "with self._rlock:\n            self.live.send_ready_atomic",
+        "if True:\n            self.live.send_ready_atomic",
+    )
+    assert _rules(linter.lint_source(good, PKG_PATH), "lock-order") == []
+
+
+def test_no_block_rule():
+    bad = FIXTURE_LOCKS + """
+import os
+
+class E2(Engine):
+    def broken(self, fh, t):
+        with self._lock:
+            os.fsync(fh.fileno())
+            t.join()
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "no-block")
+    assert len(viols) == 2
+    # str.join is not a blocking call; outside the lock nothing flags
+    good = FIXTURE_LOCKS + """
+import os
+
+class E2(Engine):
+    def fine(self, fh, t, parts):
+        with self._lock:
+            x = ", ".join(parts)
+        os.fsync(fh.fileno())
+        t.join()
+        return x
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "no-block") == []
+
+
+def test_no_block_skips_nested_defs():
+    """A closure DEFINED under the lock does not RUN under it."""
+    src = FIXTURE_LOCKS + """
+import os
+
+class E3(Engine):
+    def fine(self, fh):
+        with self._lock:
+            def later():
+                os.fsync(fh.fileno())
+        return later
+"""
+    assert _rules(linter.lint_source(src, PKG_PATH), "no-block") == []
+
+
+def test_churn_send_rule():
+    bad = """
+def broadcast(peer, msg):
+    if peer.connection is not None:
+        peer.connection.send(msg)
+        peer.connection.open_channel("doc").send(msg)
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "churn-send")
+    assert len(viols) == 2 and "try_send" in viols[0].msg
+    good = """
+def broadcast(peer, msg):
+    peer.try_send("doc", msg)
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "churn-send") == []
+    # NetworkPeer itself implements the idiom
+    assert (
+        _rules(
+            linter.lint_source(bad, "hypermerge_tpu/net/peer.py"),
+            "churn-send",
+        )
+        == []
+    )
+
+
+def test_env_registry_rule():
+    bad = """
+import os
+x = os.environ.get("HM_NOT_A_REAL_KNOB", "1")
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "env-registry")
+    assert len(viols) == 1 and "undeclared" in viols[0].msg
+    drift = """
+import os
+x = os.environ.get("HM_FSYNC", "2")
+"""
+    viols = _rules(linter.lint_source(drift, PKG_PATH), "env-registry")
+    assert len(viols) == 1 and "drifts" in viols[0].msg
+    good = """
+import os
+x = os.environ.get("HM_FSYNC", "0")
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "env-registry") == []
+
+
+def test_telemetry_name_rule():
+    bad = """
+from hypermerge_tpu import telemetry
+c = telemetry.counter("Frames_TX")
+g = telemetry.gauge("depth")
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "telemetry-name")
+    assert len(viols) == 2
+    good = """
+from hypermerge_tpu import telemetry
+c = telemetry.counter("net.tcp.frames_tx")
+d = {k: telemetry.counter("live." + k) for k in ("a", "b")}
+h = model.counter("NotARegistryCall")
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "telemetry-name") == []
+
+
+def test_raw_lock_rule():
+    bad = """
+import threading
+a = threading.Lock()
+b = threading.RLock()
+c = threading.Condition()
+"""
+    viols = _rules(linter.lint_source(bad, PKG_PATH), "raw-lock")
+    assert len(viols) == 3
+    good = """
+import threading
+from hypermerge_tpu.analysis.lockdep import make_rlock
+lk = make_rlock("util.queue")
+cv = threading.Condition(lk)
+"""
+    assert _rules(linter.lint_source(good, PKG_PATH), "raw-lock") == []
+    # outside the package (tests, tools) raw locks are fine
+    assert _rules(linter.lint_source(bad, "tools/x.py"), "raw-lock") == []
+
+
+def test_inline_suppression():
+    src = """
+import threading
+a = threading.Lock()  # lint: allow(raw-lock) — fixture exercising the suppression path
+"""
+    viols = linter.lint_source(src, PKG_PATH)
+    sup = _rules(viols, "raw-lock", suppressed=True)
+    assert len(sup) == 1 and "fixture" in sup[0].justification
+    assert linter.unsuppressed(viols) == []
+    # a justification is REQUIRED
+    bare = """
+import threading
+a = threading.Lock()  # lint: allow(raw-lock)
+"""
+    viols = linter.lint_source(bare, PKG_PATH)
+    assert _rules(viols, "raw-lock") != []
+    assert _rules(viols, "suppression") != []
+
+
+def test_file_suppression_and_stale(monkeypatch):
+    entry = suppmod.Suppression(
+        "raw-lock", "hypermerge_tpu/_fixture.py", "threading.Lock",
+        "fixture: exercising the file-suppression path",
+    )
+    monkeypatch.setattr(suppmod, "SUPPRESSIONS", (entry,))
+    src = "import threading\na = threading.Lock()\n"
+    viols = linter.lint_source(src, PKG_PATH)
+    assert linter.unsuppressed(viols) == []
+    # the same entry against a clean tree is STALE and flagged
+    viols = linter.lint_source("x = 1\n", PKG_PATH)
+    stale = _rules(viols, "suppression")
+    assert len(stale) == 1 and "stale" in stale[0].msg
+
+
+# ---------------------------------------------------------------------------
+# runtime lockdep
+
+
+@pytest.fixture
+def dep():
+    """Isolated lockdep session: enabled, empty graph; restored after."""
+    was = lockdep.enabled()
+    lockdep.enable(True)
+    lockdep.reset()
+    yield lockdep
+    lockdep.enable(was)
+    lockdep.reset()
+
+
+def test_factories_plain_when_disabled():
+    was = lockdep.enabled()
+    lockdep.enable(False)
+    try:
+        assert not isinstance(
+            lockdep.make_rlock("live.engine"), lockdep.DepLock
+        )
+        assert not isinstance(lockdep.make_lock("doc"), lockdep.DepLock)
+    finally:
+        lockdep.enable(was)
+
+
+def test_lockdep_reports_ab_ba_cycle_without_deadlock(dep):
+    """The acceptance fixture: thread 1 nests A->B, thread 2 nests
+    B->A — never concurrently, so no deadlock CAN fire — and the
+    detector still reports the potential cycle."""
+    a = dep.make_rlock("net.network")
+    b = dep.make_rlock("net.swarm")
+    t1_done = threading.Event()
+
+    def t1():
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        t1_done.wait(5)
+        with b:
+            with a:
+                pass
+
+    th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
+    th1.start(); th2.start()
+    th1.join(5); th2.join(5)
+    assert not th1.is_alive() and not th2.is_alive()
+    rep = dep.report()
+    assert len(rep["cycles"]) == 1
+    cyc = rep["cycles"][0]["cycle"]
+    assert set(cyc) == {"net.network", "net.swarm"}
+    with pytest.raises(AssertionError):
+        dep.assert_clean()
+
+
+def test_lockdep_order_and_leaf_violations(dep):
+    eng = dep.make_rlock("live.engine")
+    sql = dep.make_rlock("store.sql")
+    with sql:
+        with eng:  # store.sql (60) held while taking live.engine (10)
+            pass
+    leaf = dep.make_rlock("store.integrity")
+    feed = dep.make_rlock("store.feed")
+    with leaf:
+        with feed:
+            pass
+    kinds = sorted(v["kind"] for v in dep.report()["violations"])
+    assert kinds == ["leaf", "order", "order"]  # leaf inversion is both
+
+
+def test_lockdep_blocking_violation(dep):
+    eng = dep.make_rlock("live.engine")
+    dep.blocking("fsync")  # nothing held: fine
+    assert dep.report()["violations"] == []
+    with eng:
+        dep.blocking("fsync", "/tmp/x")
+    viol = dep.report()["violations"]
+    assert len(viol) == 1 and viol[0]["kind"] == "blocking"
+    with pytest.raises(AssertionError):
+        dep.assert_clean()
+    dep.assert_clean(allow_kinds=("blocking",))
+
+
+def test_lockdep_rlock_reentrancy_no_self_edge(dep):
+    lk = dep.make_rlock("repo")
+    with lk:
+        with lk:
+            pass
+    rep = dep.report()
+    assert rep["edges"] == [] and rep["violations"] == []
+
+
+def test_lockdep_unknown_class(dep):
+    dep.make_rlock("definitely.not.declared")
+    viol = dep.report()["violations"]
+    assert len(viol) == 1 and viol[0]["kind"] == "unknown-class"
+
+
+def test_lockdep_condition_wait_releases_held_state(dep):
+    """Condition.wait over a DepLock pops the held entry (a waiter
+    holds nothing) and re-pushes on wakeup — no phantom edges."""
+    cv = dep.make_condition("util.debounce")
+    other = dep.make_rlock("util.queue")
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=2)
+
+    t = threading.Thread(target=waiter)
+    with cv:
+        t.start()
+        # give the waiter time to block; it must NOT hold the lock
+        # class while waiting
+    t.join(5)
+    assert not t.is_alive()
+    with other:
+        pass
+    assert dep.report()["violations"] == []
+
+
+def test_registry_name_assert_under_lockdep(dep):
+    from hypermerge_tpu.telemetry import REGISTRY
+
+    with pytest.raises(ValueError):
+        REGISTRY.counter("BadFlatName")
+    REGISTRY.counter("live.test_lockdep_name_ok")  # dotted: fine
+
+
+# ---------------------------------------------------------------------------
+# regression: the sql<->cursors fix (hydration vs delete)
+
+
+def test_cursor_hydration_discards_snapshot_a_delete_raced():
+    """CursorStore._ensure_hydrated queries SQLite BEFORE taking the
+    mirror lock (the lock-order fix); a delete_doc landing between the
+    query and the merge must invalidate the snapshot, not be
+    resurrected by it."""
+    from hypermerge_tpu.storage.sql import SqlDatabase
+    from hypermerge_tpu.storage.stores import CursorStore
+
+    db = SqlDatabase(":memory:")
+    seed = CursorStore(db)
+    seed.update("r", "docX", {"a1": 5})
+    seed.update("r", "docY", {"a2": 3})
+
+    store = CursorStore(db)  # fresh mirror, unhydrated
+    real_query = db.query
+    raced = []
+
+    def racing_query(sql, params=()):
+        rows = real_query(sql, params)
+        if not raced and "FROM cursors" in sql:
+            raced.append(True)
+            store.delete_doc("r", "docX")  # lands mid-hydration
+        return rows
+
+    db.query = racing_query
+    try:
+        assert store.get("r", "docX") == {}  # NOT the stale {"a1": 5}
+        assert store.get("r", "docY") == {"a2": 3}
+        assert store.docs_with_actor("r", "a1") == []
+    finally:
+        db.query = real_query
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def test_lint_cli_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "lint.py"), "--json"],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["n_unsuppressed"] == 0
+
+
+def test_lint_cli_env_table():
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(ROOT, "tools", "lint.py"),
+            "--env-table",
+        ],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert out.returncode == 0
+    assert "HM_LOCKDEP" in out.stdout and "HM_FSYNC" in out.stdout

@@ -36,6 +36,9 @@ from hypermerge_tpu.net.tcp import TcpDuplex, TcpSwarm
 from hypermerge_tpu.repo import Repo
 
 from helpers import wait_until
+from lockdep_fixture import lockdep_suite
+
+_lockdep_suite = lockdep_suite()
 
 
 @pytest.fixture

@@ -22,9 +22,12 @@ import tempfile
 import pytest
 
 from helpers import Site, plainify, random_mutation, sync, wait_until
+from lockdep_fixture import lockdep_suite
 from hypermerge_tpu.models import Text
 from hypermerge_tpu.repo import Repo
 from hypermerge_tpu.utils.ids import validate_doc_url
+
+_lockdep_suite = lockdep_suite()
 
 
 @pytest.fixture
