@@ -24,6 +24,8 @@ import time
 from collections import deque
 from typing import Any, Callable, List, Optional, Tuple
 
+from ..analysis import lockdep
+from ..analysis.lockdep import make_condition, make_lock, make_rlock
 from ..utils.debug import log
 from .. import telemetry
 from .resilience import SessionSupervisor, dial_timeout_s
@@ -100,7 +102,7 @@ class TcpDuplex:
         # send deadlock (both sides wedge mid-burst, replication
         # freezes while the connection still reports open).
         self._outbox: deque = deque()
-        self._out_cv = threading.Condition()
+        self._out_cv = make_condition("net.tcp.outbox")
         self._out_inflight = False  # frame popped but not yet sent
         self._out_bytes = 0
         self._out_cap = _outbox_cap()  # read once: send() is hot
@@ -111,7 +113,7 @@ class TcpDuplex:
         self._rx_eof = False  # peer closed/died: draining is pointless
         self._inbox: "Queue" = Queue("tcp:inbox")
         self._close_cbs: List[Callable[[], None]] = []
-        self._lock = threading.RLock()
+        self._lock = make_rlock("net.tcp")
         self.closed = False
         # keepalive: any complete inbound frame is liveness
         self._last_rx = time.monotonic()
@@ -168,6 +170,7 @@ class TcpDuplex:
         self._sock.settimeout(10)
         pk = self._session.handshake_bytes
         frame = bytes([1 if offer else 0]) + pk
+        lockdep.blocking("socket_send", "handshake")
         self._sock.sendall(_HDR.pack(len(frame)) + frame)
         hdr = self._read_exact(_HDR.size)
         if hdr is None:
@@ -190,6 +193,7 @@ class TcpDuplex:
             auth = self._session.encrypt(
                 self._session.auth_frame(self._identity)
             )
+            lockdep.blocking("socket_send", "auth")
             self._sock.sendall(_HDR.pack(len(auth)) + auth)
             hdr = self._read_exact(_HDR.size)
             if hdr is None:
@@ -328,6 +332,7 @@ class TcpDuplex:
                 # the single writer thread orders encryption and writes
                 if self._session is not None:
                     data = self._session.encrypt(data)
+                lockdep.blocking("socket_send", "frame")
                 self._sock.sendall(_HDR.pack(len(data)) + data)
                 _M_FRAMES_TX.add(1)
                 _M_BYTES_TX.add(_HDR.size + len(data))
@@ -464,7 +469,7 @@ class TcpSwarm(Swarm):
         self.join_options: dict = {}
         self._cb: Optional[Callable] = None
         self._duplexes: List[TcpDuplex] = []
-        self._dlock = threading.Lock()
+        self._dlock = make_lock("net.tcp.server")
         self._destroyed = False
         self._identity: Optional[bytes] = identity
         self._banned_ids: set = set()  # proven peer identities
