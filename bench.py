@@ -663,6 +663,157 @@ def _config6_demote_readopt(n_ops=4096, n_docs=3, rounds=3):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _config_read(tmp, urls):
+    """BASELINE round-15 serving config (ISSUE 11): N concurrent
+    reader threads point-read the stored corpus through the
+    HBM-resident serving tier — a hot/cold mix (90% of reads over a
+    32-doc hot set, 10% uniform over BENCH_READ_DOCS docs). Reports
+    read QPS, p50/p99 read latency from the telemetry histogram
+    (serve.read_s), the tier's counters, and the measured speedup over
+    per-request host materialization of the same mix (the HM_SERVE=0
+    cost). Scale with BENCH_READERS / BENCH_READS / BENCH_READ_DOCS
+    (corpus size itself rides BENCH_DOCS).
+
+    The speedup is doc-size-sensitive: host materialization is O(doc)
+    per read while a served read is ~constant (batcher round trip +
+    one shared dispatch), so tiny-doc corpora (BENCH_OPS <~ 256) can
+    read below 1x — the tier's regime is the default 1k-op docs and
+    up, where same-box runs measure ~13x."""
+    import random as _rnd
+    import threading as _th
+
+    from hypermerge_tpu import telemetry
+    from hypermerge_tpu.repo import Repo
+    from hypermerge_tpu.serve.tier import host_value
+    from hypermerge_tpu.utils.ids import validate_doc_url
+
+    readers = int(os.environ.get("BENCH_READERS", "8"))
+    n_reads = int(os.environ.get("BENCH_READS", "4000"))
+    n_sub = int(os.environ.get("BENCH_READ_DOCS", "2048"))
+    host_reads = max(64, n_reads // 16)
+    repo = Repo(path=tmp)
+    try:
+        if repo.back.serve is None:
+            raise RuntimeError("serving tier off (HM_SERVE=0)")
+        sub = urls[: min(len(urls), n_sub)]
+        repo.open_many(sub)
+        repo.back.fetch_bulk_summaries()
+        hot = sub[:32]
+        rng = _rnd.Random(0xEAD5)
+        mix = [
+            hot[rng.randrange(len(hot))]
+            if rng.random() < 0.9
+            else sub[rng.randrange(len(sub))]
+            for _ in range(n_reads)
+        ]
+        query = {"kind": "len", "path": []}
+        for u in hot:  # steady state: hot set resident before timing
+            repo.read(u, query)
+        hist = repo.back.serve._hist
+        h0 = hist.value()
+        snap0 = telemetry.snapshot()
+
+        # -- timed: concurrent readers over the served tier ------------
+        errs = []
+
+        def reader(n):
+            try:
+                for i in range(n, n_reads, readers):
+                    if repo.read(mix[i], query) is None:
+                        raise AssertionError(f"None read for {mix[i]}")
+            except Exception as e:  # pragma: no cover - failure surface
+                errs.append(e)
+
+        threads = [
+            _th.Thread(target=reader, args=(n,)) for n in range(readers)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        h1 = hist.value()
+        snap1 = telemetry.snapshot()
+        qps = n_reads / dt
+        p50 = _hist_quantile(hist.buckets, h0, h1, 0.50)
+        p99 = _hist_quantile(hist.buckets, h0, h1, 0.99)
+        fallbacks = snap1["serve.fallbacks"] - snap0.get(
+            "serve.fallbacks", 0
+        )
+
+        # -- baseline: per-request host materialization, same mix, same
+        # thread count (what every one of these reads cost pre-tier) --
+        docs = {
+            u: repo.back.docs[validate_doc_url(u)] for u in set(mix)
+        }
+        herrs = []
+
+        def host_reader(n):
+            try:
+                for i in range(n, host_reads, readers):
+                    if host_value(docs[mix[i]], query) is None:
+                        raise AssertionError("None host read")
+            except Exception as e:  # pragma: no cover
+                herrs.append(e)
+
+        threads = [
+            _th.Thread(target=host_reader, args=(n,))
+            for n in range(readers)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        host_dt = time.perf_counter() - t0
+        if herrs:
+            raise herrs[0]
+        host_qps = host_reads / host_dt
+        stats = {
+            "docs": len(sub),
+            "readers": readers,
+            "reads": n_reads,
+            "hot_docs": len(hot),
+            "fallbacks_steady": int(fallbacks),
+            "batches": int(
+                snap1["serve.batches"] - snap0.get("serve.batches", 0)
+            ),
+            "installs": int(
+                snap1["serve.installs"] - snap0.get("serve.installs", 0)
+            ),
+            "hits": int(
+                snap1["serve.hits"] - snap0.get("serve.hits", 0)
+            ),
+            "resident_bytes": snap1.get("serve.resident_bytes", 0),
+        }
+        return qps, p50, p99, host_qps, stats
+    finally:
+        repo.close()
+
+
+def _hist_quantile(bounds, before, after, q):
+    """Quantile (ms) from the delta of two Histogram.value() snapshots:
+    the upper bound of the bucket where the cumulative count crosses
+    q (the +Inf tail reports the largest finite bound)."""
+    counts = [
+        b - a for a, b in zip(before["buckets"], after["buckets"])
+    ]
+    n = sum(counts)
+    if n <= 0:
+        return None
+    target = q * n
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= target:
+            bound = bounds[min(i, len(bounds) - 1)]
+            return round(bound * 1e3, 3)
+    return round(bounds[-1] * 1e3, 3)
+
+
 def _config5_union(n_docs=100_000, n_actors=64, seed=0, dirty=1000):
     """100k-doc clock union served from the device-RESIDENT ClockStore
     mirror (ops/clock_mirror.py; BASELINE config 5). Setup uploads the
@@ -1072,6 +1223,17 @@ def main() -> None:
             f"path): {cfg3[0]:.2f}s -> {cfg3[1]:,.0f} ops/s",
             file=sys.stderr,
         )
+    cfgrd = _soft("config_read", lambda: _config_read(tmp, urls))
+    if cfgrd is not None:
+        print(
+            f"# config_read serving tier: {cfgrd[0]:,.0f} reads/s "
+            f"(p50 {cfgrd[1]}ms p99 {cfgrd[2]}ms) vs host "
+            f"per-request {cfgrd[3]:,.0f} reads/s -> "
+            f"{cfgrd[0] / max(cfgrd[3], 1e-9):.1f}x "
+            f"(fallbacks {cfgrd[4]['fallbacks_steady']}, "
+            f"batches {cfgrd[4]['batches']})",
+            file=sys.stderr,
+        )
     rtt = _soft("tunnel_rtt", _tunnel_rtt_ms)
     if rtt is not None:
         print(
@@ -1167,6 +1329,26 @@ def main() -> None:
                     ),
                     "config5_union_100k_ms": (
                         round(cfg5, 1) if cfg5 is not None else None
+                    ),
+                    "config_read_qps": (
+                        round(cfgrd[0]) if cfgrd is not None else None
+                    ),
+                    "config_read_p50_ms": (
+                        cfgrd[1] if cfgrd is not None else None
+                    ),
+                    "config_read_p99_ms": (
+                        cfgrd[2] if cfgrd is not None else None
+                    ),
+                    "config_read_host_qps": (
+                        round(cfgrd[3]) if cfgrd is not None else None
+                    ),
+                    "config_read_speedup": (
+                        round(cfgrd[0] / max(cfgrd[3], 1e-9), 1)
+                        if cfgrd is not None
+                        else None
+                    ),
+                    "config_read": (
+                        cfgrd[4] if cfgrd is not None else None
                     ),
                     "config6_text_trace_ops_per_s": (
                         round(cfg6[1]) if cfg6 is not None else None

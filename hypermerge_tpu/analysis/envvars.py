@@ -108,6 +108,20 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "application debouncer."),
     EnvVar("HM_CLOCK_MIRROR", "1", "Device-resident clock mirror for "
            "bulk union/dominated queries."),
+    # -- read-serving tier ---------------------------------------------
+    EnvVar("HM_SERVE", "1", "HBM-resident read-serving tier: reads "
+           "answer from batched device query kernels over resident "
+           "summary columns (0 = per-request host materialization "
+           "twin)."),
+    EnvVar("HM_SERVE_MAX_BYTES", "268435456", "Resident-bytes budget "
+           "of the serving tier (LRU eviction), applied to the device "
+           "residency cache and the host fallback memo each."),
+    EnvVar("HM_SERVE_BATCH_MS", "1", "Debounce window of the read "
+           "batcher: concurrent reads inside it coalesce into one "
+           "batched kernel dispatch."),
+    EnvVar("HM_SERVE_QUEUE", "4096", "Bound of the read admission "
+           "queue; overflowing reads degrade to the host path "
+           "(serve.fallbacks) instead of queueing unboundedly."),
     # -- network --------------------------------------------------------
     EnvVar("HM_GOSSIP_FLUSH_MS", "10", "Window of the cursor/clock "
            "gossip broadcast debouncer."),

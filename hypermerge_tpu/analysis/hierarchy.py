@@ -165,6 +165,24 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         leaf=True,
     ),
     LockClass(
+        "serve.cache", 74,
+        "serve.resident.ResidencyCache._lock — the HBM residency "
+        "table (entries, LRU order, byte budget) plus the serve "
+        "tier's host-side memo. Entry BUILDS (pack + kernel + device "
+        "upload) run with NO serve lock held (the PR-4 "
+        "install-and-recheck idiom); the critical sections are dict "
+        "bookkeeping only, so nothing but the telemetry/debug leaves "
+        "may be acquired under it. Ranks above the store locks: "
+        "write-path emission hooks (engine lock held) mark entries "
+        "stale under it.",
+    ),
+    LockClass(
+        "serve.batch", 76,
+        "serve.batcher.ReadBatcher._lock — admission-queue depth "
+        "accounting. Held for counter arithmetic only; the debounced "
+        "flush (util.debounce) is always marked OUTSIDE it.",
+    ),
+    LockClass(
         "util.debounce", 78,
         "Debouncer._lock/_cv — mark/flush handshake. flush_fn runs "
         "with NO debouncer lock held, so flushes may take any lock; "

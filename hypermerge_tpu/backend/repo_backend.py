@@ -236,6 +236,19 @@ class RepoBackend:
             from .live import LiveApplyEngine
 
             self.live = LiveApplyEngine(self)
+        # read-serving tier (serve/): reads answer from HBM-resident
+        # summary columns through batched query kernels. HM_SERVE=0
+        # keeps per-request host materialization as the bit-identical
+        # twin; a tier that cannot come up (no usable jax backend)
+        # degrades to the same twin rather than failing the repo.
+        self.serve = None
+        if os.environ.get("HM_SERVE", "1") != "0":
+            try:
+                from ..serve import ServeTier
+
+                self.serve = ServeTier(self)
+            except Exception as e:
+                log("repo:backend", f"no serve tier: {e}")
 
     @staticmethod
     def _fsync_dir(path: str) -> None:
@@ -367,6 +380,8 @@ class RepoBackend:
             self.docs.pop(doc_id, None)
         if self.live is not None:
             self.live.drop(doc_id)
+        if self.serve is not None:
+            self.serve.drop(doc_id)
 
     def destroy(self, doc_id: str) -> None:
         """Remove ALL doc state: store rows AND the on-disk feeds
@@ -1648,6 +1663,13 @@ class RepoBackend:
     def _doc_notify(self, event: Dict[str, Any]) -> None:
         t = event["type"]
         doc: DocBackend = event["doc"]
+        if t in ("LocalPatch", "RemotePatch") and self.serve is not None:
+            # serving invalidation hook: every patch emission — host
+            # paths AND live-engine ticks (_emit_tick notifies through
+            # here) — moves the doc's serving clock, so its resident
+            # read entry can never serve again. Bookkeeping only
+            # (this runs under the emission lock).
+            self.serve.note_clock_moved(doc.id)
         if t == "DocReady":
             self._send_ready(doc)
         elif t == "LocalPatch":
@@ -1749,8 +1771,63 @@ class RepoBackend:
     # ------------------------------------------------------------------
     # queries
 
+    def read_doc(
+        self, doc_id: str, query: Dict[str, Any], cb: Callable[[Any], None]
+    ) -> None:
+        """One read through the serving tier (HM_SERVE=1) or the
+        per-request host twin (HM_SERVE=0). `cb(payload)` may fire on
+        the tier's batcher thread; payload None = unknown doc / not
+        ready. A read NEVER creates state: a doc id with no stored
+        cursor answers None instead of materializing a phantom doc."""
+        doc = self.docs.get(doc_id)
+        if doc is None:
+            if not self.cursors.get(self.id, doc_id):
+                cb(None)
+                return
+            try:
+                doc = self.open(doc_id)
+            except Exception as e:
+                log("repo:backend", f"read open {doc_id[:6]}: {e}")
+                cb(None)
+                return
+        if self.serve is not None:
+            self.serve.read_async(doc, query, cb)
+            return
+        from ..serve.tier import host_read
+
+        cb(host_read(doc, query))
+
+    def telemetry_payload(self) -> Dict[str, Any]:
+        """The Telemetry query's reply — ONE assembly for every seam
+        that answers it (handle_query here, tools/serve.py's --ipc
+        QueryServer): the process-wide registry snapshot + trace state
+        (tools/top.py's rate feed) plus THIS backend's per-doc
+        read-serving residency block (tools/ls.py's residency=
+        column)."""
+        payload = telemetry.query_payload()
+        if self.serve is not None:
+            payload["serve"] = self.serve.residency_report()
+        return payload
+
     def handle_query(self, query_id: int, query: Dict[str, Any]) -> None:
         t = query["type"]
+        if t == "Read":
+            # async: the tier's batcher thread pushes the Reply, so a
+            # steady-state read never stalls the backend message pump
+            # (queue callbacks are serialized) while a batch
+            # coalesces. At admission overflow (HM_SERVE_QUEUE full)
+            # the refused read IS answered inline on this thread —
+            # deliberate backpressure: the overloading reader pays
+            # the host-path cost instead of growing an unbounded
+            # queue.
+            self.read_doc(
+                query["id"],
+                query.get("query") or {},
+                lambda payload: self.to_frontend.push(
+                    msgs.reply_msg(query_id, payload)
+                ),
+            )
+            return
         if t == "Materialize":
             doc = self.docs.get(query["id"])
             patch = (
@@ -1775,11 +1852,8 @@ class RepoBackend:
                 }
             self.to_frontend.push(msgs.reply_msg(query_id, payload))
         elif t == "Telemetry":
-            # live introspection over the IPC/serve seam (tools/top.py):
-            # the process-wide registry snapshot + trace state, stamped
-            # for rate computation between polls
             self.to_frontend.push(
-                msgs.reply_msg(query_id, telemetry.query_payload())
+                msgs.reply_msg(query_id, self.telemetry_payload())
             )
         else:
             self.to_frontend.push(msgs.reply_msg(query_id, None))
@@ -1934,6 +2008,8 @@ class RepoBackend:
                 ctx.join()
             except Exception as e:
                 log("repo:backend", f"bulk fetch at close: {e}")
+        if self.serve is not None:
+            self.serve.close()  # drains: in-flight reads answer first
         if self.live is not None:
             self.live.close()  # drains: final tick patches still emit
         self._gossip.close()

@@ -173,6 +173,40 @@ class RepoFrontend:
 
         self._query(msgs.materialize_query(doc_id, history), on_reply)
 
+    def read(
+        self,
+        url: str,
+        query: Dict[str, Any],
+        cb: Optional[Callable[[Any], None]] = None,
+        timeout: float = 30.0,
+    ) -> Any:
+        """One-shot read through the backend's serving tier
+        (serve/tier.py READ_KINDS: lookup/index/text/len/clock/
+        history). With cb: async callback(value). Without: blocking
+        convenience. Returns the read VALUE; None for an unknown /
+        not-ready doc or a broken path — identical under HM_SERVE=1
+        (batched device kernels over HBM-resident state) and
+        HM_SERVE=0 (per-request host materialization)."""
+        doc_id = validate_doc_url(url)
+        if cb is not None:
+            self._query(
+                msgs.read_query(doc_id, query),
+                lambda p: cb(None if p is None else p.get("value")),
+            )
+            return None
+        done = threading.Event()
+        slot: list = [None]
+
+        def fin(payload):
+            slot[0] = payload
+            done.set()
+
+        self._query(msgs.read_query(doc_id, query), fin)
+        if not done.wait(timeout):
+            raise TimeoutError(f"read of {doc_id[:6]} timed out")
+        payload = slot[0]
+        return None if payload is None else payload.get("value")
+
     def meta(self, url: str, cb: Callable[[Any], None]) -> None:
         _scheme, id_ = validate_url(url)
         self._query(msgs.metadata_query(id_), cb)

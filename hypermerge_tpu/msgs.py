@@ -74,6 +74,14 @@ def metadata_query(url_id: str) -> Dict[str, Any]:
     return {"type": "Metadata", "id": url_id}
 
 
+def read_query(doc_id: str, query: Dict[str, Any]) -> Dict[str, Any]:
+    """A one-shot read against the serving tier (serve/tier.py
+    READ_KINDS): answered from HBM-resident state under HM_SERVE=1,
+    from per-request host materialization under HM_SERVE=0 —
+    bit-identical payloads either way."""
+    return {"type": "Read", "id": doc_id, "query": dict(query)}
+
+
 def telemetry_query() -> Dict[str, Any]:
     """Process-wide telemetry snapshot (counters + trace state) from
     the backend — the live-introspection feed tools/top.py polls over

@@ -49,6 +49,19 @@ class Repo:
     def doc(self, url: str, cb: Optional[Callable] = None) -> Any:
         return self.front.doc(url, cb)
 
+    def read(
+        self, url: str, query: dict, cb: Optional[Callable] = None
+    ) -> Any:
+        """One-shot read served WITHOUT materializing the doc
+        host-side: under HM_SERVE=1 (default) the backend's serving
+        tier answers from HBM-resident summary columns via batched
+        device query kernels; HM_SERVE=0 is the bit-identical
+        per-request host twin. Query kinds: {"kind": "text", "path":
+        ["body"]}, {"kind": "lookup", "path": ["a", "b"]}, {"kind":
+        "index", "path": ["list"], "index": 3}, {"kind": "len",
+        "path": []}, {"kind": "clock"}, {"kind": "history"}."""
+        return self.front.read(url, query, cb)
+
     def watch(self, url: str, cb: Callable[[Any, int], None]) -> Handle:
         return self.front.watch(url, cb)
 

@@ -1,0 +1,15 @@
+"""The HBM-resident read-serving tier (ISSUE 11).
+
+Layout:
+- ``resident.py`` — the residency cache: per-doc summary lanes pinned
+  in device memory, keyed by serving clock, byte-bounded LRU.
+- ``kernels.py`` — batched query kernels (element order, map lookup,
+  counts) in the PR-7 cached program table.
+- ``batcher.py`` — bounded admission + debounced batch flush.
+- ``tier.py`` — ServeTier (the RepoBackend-facing surface) and
+  ``host_read``, the bit-identical HM_SERVE=0 twin.
+"""
+
+from .tier import READ_KINDS, ServeTier, host_read
+
+__all__ = ["READ_KINDS", "ServeTier", "host_read"]

@@ -1,0 +1,180 @@
+"""Batched device query kernels for the read-serving tier.
+
+One read of a resident doc never materializes anything host-side: the
+structural queries — element order of a text/list object, winner row of
+a (map, key) pair, live-entry counts — run as jitted programs over the
+stacked summary lanes of EVERY read in the batch, so a thousand
+concurrent reads cost one dispatch per (query kind, shape bucket)
+instead of a thousand host summary parses.
+
+The programs live in the PR-7 cached program table
+(parallel/sharded._PROGRAMS): one trace per ("serve", kind, B, N) key
+for the life of the process, pinned by the same trace_counts regression
+mechanism the mesh programs use. Batch axes bucket to pow2 so a varying
+read mix reuses a handful of executables.
+
+Lane layout (serve/resident.py uploads one stacked [LANES, N] int32
+array per resident doc — a single host->device transfer per install):
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+# stacked-lane row indices (ResidentDoc.dev is [LANES, N] int32)
+L_LIVE = 0     # elem_live: INS rows whose element has a visible value
+L_RANK = 1     # RGA order key (higher = earlier)
+L_OBJ = 2      # container MAKE row (-1 = root map)
+L_INSERT = 3   # 1 on element-creating ops
+L_KEY = 4      # key-table index (-1 = none)
+L_MAPWIN = 5   # winning visible op of its (obj, key)
+N_LANES = 6
+
+_INT32_MAX = 2**31 - 1
+
+# qobj value that matches no container: real obj rows are >= -1 (root)
+NO_OBJ = -7
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _program(kind: str, B: int, N: int, build):
+    """A jitted serve program from the shared mesh program table —
+    ("serve", kind, B, N) keys sit next to the mesh keys, and
+    sharded.trace_counts pins the one-trace contract for both."""
+    from ..parallel import sharded
+
+    key = ("serve", kind, B, N)
+    return sharded._program(
+        key, lambda: _jit(sharded._traced(key, build()))
+    )
+
+
+def _jit(fn):
+    import jax
+
+    return jax.jit(fn)
+
+
+def stack_entries(entries: Sequence) -> tuple:
+    """The batch's resident lanes as a pow2-padded TUPLE of [LANES, N]
+    device arrays. The stack into [B, LANES, N] happens INSIDE the
+    jitted program (a pytree argument), so it fuses into the one
+    dispatch instead of paying a per-buffer concat on the way in.
+    Padding repeats the first entry's array — zero new device
+    allocations; pad lanes are masked out by the NO_OBJ query pad."""
+    from ..ops.columnar import round_up_pow2
+
+    B = round_up_pow2(max(1, len(entries)))
+    devs = [e.dev for e in entries]
+    if len(devs) < B:
+        devs.extend([devs[0]] * (B - len(devs)))
+    return tuple(devs)
+
+
+def _pad_q(vals: List[int], B: int, fill: int) -> np.ndarray:
+    out = np.full(B, fill, np.int32)
+    out[: len(vals)] = np.asarray(vals, np.int32)
+    return out
+
+
+def _build_map_lookup():
+    def fn(arrs, qobj, qkey):
+        jnp = _jnp()
+        stacked = jnp.stack(arrs)
+        mask = (
+            (stacked[:, L_MAPWIN] != 0)
+            & (stacked[:, L_KEY] == qkey[:, None])
+            & (stacked[:, L_OBJ] == qobj[:, None])
+        )
+        row = jnp.argmax(mask, axis=1).astype(jnp.int32)
+        return row, mask.any(axis=1)
+
+    return fn
+
+
+def _build_seq_order():
+    def fn(arrs, qobj):
+        jnp = _jnp()
+        stacked = jnp.stack(arrs)
+        mask = (
+            (stacked[:, L_LIVE] != 0)
+            & (stacked[:, L_OBJ] == qobj[:, None])
+            & (stacked[:, L_INSERT] == 1)
+        )
+        # descending rank, ties in row order — the decode_patch element
+        # order (jnp.argsort is stable)
+        key = jnp.where(mask, -stacked[:, L_RANK], _INT32_MAX)
+        order = jnp.argsort(key, axis=1).astype(jnp.int32)
+        return order, mask.sum(axis=1).astype(jnp.int32)
+
+    return fn
+
+
+def _build_counts():
+    def fn(arrs, qobj):
+        stacked = _jnp().stack(arrs)
+        at_obj = stacked[:, L_OBJ] == qobj[:, None]
+        n_elems = (
+            ((stacked[:, L_LIVE] != 0) & at_obj & (stacked[:, L_INSERT] == 1))
+            .sum(axis=1)
+            .astype("int32")
+        )
+        n_map = (
+            ((stacked[:, L_MAPWIN] != 0) & at_obj).sum(axis=1).astype("int32")
+        )
+        return n_elems, n_map
+
+    return fn
+
+
+def map_lookup(
+    entries: Sequence, qobjs: List[int], qkeys: List[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Winner value row per (doc, container, key): [B] rows + [B] found
+    mask. One dispatch for the whole group."""
+    jnp = _jnp()
+    arrs = stack_entries(entries)
+    B, N = len(arrs), arrs[0].shape[1]
+    fn = _program("map_lookup", B, N, _build_map_lookup)
+    row, found = fn(
+        arrs,
+        jnp.asarray(_pad_q(qobjs, B, NO_OBJ)),
+        jnp.asarray(_pad_q(qkeys, B, -1)),
+    )
+    return np.asarray(row), np.asarray(found)
+
+
+def seq_order(
+    entries: Sequence, qobjs: List[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Element order (live INS rows, descending rank) per (doc,
+    container): [B, N] row order + [B] live counts."""
+    jnp = _jnp()
+    arrs = stack_entries(entries)
+    B, N = len(arrs), arrs[0].shape[1]
+    fn = _program("seq_order", B, N, _build_seq_order)
+    order, count = fn(
+        arrs, jnp.asarray(_pad_q(qobjs, B, NO_OBJ))
+    )
+    return np.asarray(order), np.asarray(count)
+
+
+def counts(
+    entries: Sequence, qobjs: List[int]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """([B] live element counts, [B] map entry counts) per container."""
+    jnp = _jnp()
+    arrs = stack_entries(entries)
+    B, N = len(arrs), arrs[0].shape[1]
+    fn = _program("counts", B, N, _build_counts)
+    n_elems, n_map = fn(
+        arrs, jnp.asarray(_pad_q(qobjs, B, NO_OBJ))
+    )
+    return np.asarray(n_elems), np.asarray(n_map)

@@ -11,8 +11,11 @@ Usage:
     python scripts/profile_trace.py /tmp/t.json [--by name|cat]
         [--top N] [--threads]
 
---by cat groups by subsystem (live/pipeline/net/storage/mesh) instead
-of span name; --threads adds a per-thread busy breakdown.
+--by cat groups by subsystem (live/pipeline/net/storage/mesh/serve)
+instead of span name; --threads adds a per-thread busy breakdown. The
+serving tier's spans show up as `serve.read` (per-request latency,
+admission to completion) and `serve.batch` (one coalesced kernel
+flush) — their count ratio IS the read-batching factor.
 """
 
 import argparse

@@ -1,0 +1,146 @@
+"""Serving-tier race drivers under HM_LOCKDEP=1 (ISSUE 11).
+
+Concurrent writers, readers, and eviction churn exercise every serve
+lock (serve.cache, serve.batch) against the engine/doc/store locks;
+the module teardown asserts the observed lock-order graph is clean —
+no potential deadlock cycle, no hierarchy inversion — even though no
+deadlock fired. The chaos test also pins the freshness contract: a
+read issued after a patch was delivered NEVER returns state older
+than that patch.
+"""
+
+import threading
+
+import pytest
+
+from hypermerge_tpu.models import Text
+from hypermerge_tpu.repo import Repo
+from lockdep_fixture import lockdep_suite
+
+_lockdep = lockdep_suite()
+
+
+@pytest.fixture
+def repo():
+    r = Repo(memory=True)
+    yield r
+    r.close()
+
+
+def test_eviction_churn_race(repo, monkeypatch):
+    """Readers over more docs than the byte budget holds: every read
+    races installs + LRU evictions of the others. Values must stay
+    correct and the lock graph clean."""
+    monkeypatch.setenv("HM_SERVE_MAX_BYTES", "4000")
+    urls = []
+    for i in range(6):
+        u = repo.create({"i": i})
+        repo.change(u, lambda d, i=i: d.__setitem__("t", Text(f"doc{i}")))
+        urls.append(u)
+    errors = []
+
+    def reader(n):
+        try:
+            for j in range(10):
+                i = (n + j) % len(urls)
+                v = repo.read(urls[i], {"kind": "text", "path": ["t"]})
+                assert v == f"doc{i}", v
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    ts = [threading.Thread(target=reader, args=(n,)) for n in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errors
+
+
+def test_invalidation_race(repo):
+    """Writers move clocks while readers install/serve: a read may see
+    the pre- or post-edit value of a CONCURRENT edit, but never a
+    value that contradicts the doc's committed history (values only
+    ever grow through the append-only script below)."""
+    url = repo.create()
+    repo.change(url, lambda d: d.__setitem__("n", 0))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        try:
+            for i in range(1, 30):
+                repo.change(url, lambda d, i=i: d.__setitem__("n", i))
+        finally:
+            stop.set()
+
+    def reader():
+        last = -1
+        try:
+            while not stop.is_set() or last < 0:
+                v = repo.read(url, {"kind": "lookup", "path": ["n"]})
+                assert v is not None and v >= last, (v, last)
+                last = v
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    ts = [threading.Thread(target=reader) for _ in range(3)]
+    w = threading.Thread(target=writer)
+    for t in ts:
+        t.start()
+    w.start()
+    w.join()
+    for t in ts:
+        t.join()
+    assert not errors
+
+
+def test_no_stale_read_past_delivered_patch(repo):
+    """The live-edit-during-read chaos test: a watcher records each
+    delivered patch's text length; every read issued AFTER a delivery
+    must reflect at least that much text (the serving clock moved
+    before the patch reached the frontend, so a resident entry built
+    earlier can never serve the newer read)."""
+    url = repo.create()
+    repo.change(url, lambda d: d.__setitem__("t", Text("")))
+    seen = [0]  # longest delivered text, updated by the watcher
+
+    def watch(state, _idx):
+        t = state.get("t")
+        if isinstance(t, Text) and len(t) > seen[0]:
+            seen[0] = len(t)
+
+    handle = repo.watch(url, watch)
+    errors = []
+    stop = threading.Event()
+
+    def writer():
+        try:
+            for i in range(40):
+                repo.change(
+                    url,
+                    lambda d, i=i: d["t"].insert(len(d["t"]), "x"),
+                )
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                floor = seen[0]  # delivered BEFORE this read is issued
+                v = repo.read(url, {"kind": "text", "path": ["t"]})
+                assert v is not None and len(v) >= floor, (len(v), floor)
+        except Exception as e:  # pragma: no cover - failure surface
+            errors.append(e)
+
+    rs = [threading.Thread(target=reader) for _ in range(2)]
+    w = threading.Thread(target=writer)
+    for t in rs:
+        t.start()
+    w.start()
+    w.join()
+    for t in rs:
+        t.join()
+    handle.close()
+    assert not errors
+    # the final read observes the full 40-char text
+    assert repo.read(url, {"kind": "text", "path": ["t"]}) == "x" * 40

@@ -1,0 +1,135 @@
+"""HM_SERVE=1/0 twin fuzz: reads are bit-identical across random
+edit/read interleavings, run in BOTH env orders (ISSUE 11 acceptance).
+
+One deterministic script of edits + reads runs against a served repo
+and against the per-request host-materialization twin; every read's
+value must match exactly. Clock reads normalize actor ids (keys are
+random per run) but pin the seq multiset.
+"""
+
+import random
+
+import pytest
+
+from hypermerge_tpu.models import Counter, Text
+from hypermerge_tpu.repo import Repo
+
+KEYS = ["a", "b", "c", "text", "list", "deep"]
+
+
+def _edit(rng):
+    """One random mutation closure + its tag (deterministic given the
+    rng stream)."""
+    roll = rng.random()
+    if roll < 0.25:
+        k, v = rng.choice(KEYS[:3]), rng.randrange(100)
+        return lambda d: d.__setitem__(k, v)
+    if roll < 0.40:
+        s = "".join(rng.choice("abcdef") for _ in range(3))
+        def set_text(d):
+            if not isinstance(d.get("text"), Text):
+                d["text"] = Text(s)
+            else:
+                d["text"].insert(
+                    rng.randrange(len(d["text"]) + 1) if len(d["text"])
+                    else 0,
+                    s,
+                )
+        return set_text
+    if roll < 0.55:
+        vals = [rng.randrange(10) for _ in range(rng.randrange(1, 4))]
+        return lambda d: d.__setitem__("list", vals)
+    if roll < 0.70:
+        def bump(d):
+            if isinstance(d.get("ctr"), Counter):
+                d.increment("ctr", 1)
+            else:
+                d["ctr"] = Counter(rng.randrange(5))
+        return bump
+    if roll < 0.85:
+        return lambda d: d.__setitem__(
+            "deep", {"x": {"y": rng.randrange(50)}}
+        )
+    k = rng.choice(KEYS[:3])
+    def remove(d):
+        if k in d:
+            del d[k]
+    return remove
+
+
+def _reads(rng):
+    return [
+        {"kind": "text", "path": ["text"]},
+        {"kind": "lookup", "path": [rng.choice(KEYS[:3])]},
+        {"kind": "lookup", "path": ["deep", "x", "y"]},
+        {"kind": "lookup", "path": ["ctr"]},
+        {"kind": "len", "path": []},
+        {"kind": "len", "path": ["list"]},
+        {"kind": "index", "path": ["list"], "index": rng.randrange(4)},
+        {"kind": "history"},
+        {"kind": "clock"},
+    ]
+
+
+def _normalize(q, v):
+    if q["kind"] == "clock" and isinstance(v, list):
+        # actor keys are random per run: pin the seq multiset only
+        return sorted(s.rsplit(":", 1)[-1] for s in v)
+    return v
+
+
+def run_script(seed: int, serve: str, monkeypatch) -> list:
+    monkeypatch.setenv("HM_SERVE", serve)
+    rng = random.Random(seed)
+    repo = Repo(memory=True)
+    out = []
+    try:
+        assert (repo.back.serve is None) == (serve == "0")
+        urls = [repo.create() for _ in range(3)]
+        for step in range(40):
+            url = urls[rng.randrange(len(urls))]
+            if rng.random() < 0.55:
+                repo.change(url, _edit(rng))
+            else:
+                for q in _reads(rng):
+                    out.append(
+                        (step, q["kind"], _normalize(q, repo.read(url, q)))
+                    )
+    finally:
+        repo.close()
+    return out
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+@pytest.mark.parametrize("order", ["serve-first", "host-first"])
+def test_twin_reads_bit_identical(seed, order, monkeypatch):
+    first, second = ("1", "0") if order == "serve-first" else ("0", "1")
+    a = run_script(seed, first, monkeypatch)
+    b = run_script(seed, second, monkeypatch)
+    assert a == b
+
+
+def test_twin_interleaved_invalidation(monkeypatch):
+    """Tight edit->read->edit->read alternation: every read observes
+    exactly the post-edit state under both modes (the clock-driven
+    invalidation can never serve a stale resident entry)."""
+
+    def run(serve):
+        monkeypatch.setenv("HM_SERVE", serve)
+        repo = Repo(memory=True)
+        try:
+            url = repo.create()
+            repo.change(url, lambda d: d.__setitem__("t", Text("")))
+            vals = []
+            for i in range(12):
+                repo.change(
+                    url, lambda d, i=i: d["t"].insert(len(d["t"]), str(i))
+                )
+                vals.append(repo.read(url, {"kind": "text", "path": ["t"]}))
+            return vals
+        finally:
+            repo.close()
+
+    served, host = run("1"), run("0")
+    assert served == host
+    assert served[-1] == "".join(str(i) for i in range(12))

@@ -41,6 +41,7 @@ def test_ls_and_watch_once(tmp_path):
     assert out.returncode == 0, out.stderr
     assert url in out.stdout
     assert "integrity=OK" in out.stdout
+    assert "residency=" in out.stdout  # read-serving column (ISSUE 11)
 
     out = _run(["tools/watch.py", path, url, "--once"])
     assert out.returncode == 0, out.stderr
@@ -412,3 +413,96 @@ def test_profile_trace_timeline(tmp_path):
     assert "concurrency" in out.stdout
     out = _run(["scripts/profile_trace.py", trace_path, "--by", "cat"])
     assert "pipeline" in out.stdout
+
+
+def test_serve_ipc_read_queries(tmp_path):
+    """tools/serve.py --ipc answers Read queries through the serving
+    tier and Telemetry queries with the residency block — one daemon
+    replicates to peers AND serves point reads off HBM state."""
+    import socket as socketmod
+
+    from hypermerge_tpu import msgs
+    from hypermerge_tpu.models import Text
+    from hypermerge_tpu.net.tcp import TcpDuplex
+    from hypermerge_tpu.utils.ids import validate_doc_url
+
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"title": "served"})
+    repo.change(url, lambda d: d.__setitem__("t", Text("from-hbm")))
+    repo.close()
+    doc_id = validate_doc_url(url)
+
+    sock_path = str(tmp_path / "serve.sock")
+    serve = subprocess.Popen(
+        [
+            sys.executable, "tools/serve.py", path,
+            "--port", "0", "--ipc", sock_path,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=ENV,
+        cwd=REPO_ROOT,
+    )
+    try:
+        next_line = _line_reader(serve.stdout)
+        deadline = time.monotonic() + 60
+        announced = False
+        while time.monotonic() < deadline:
+            line = next_line(timeout=1.0)
+            if line and "serving" in line:
+                announced = True
+                break
+        assert announced, "serve never announced"
+
+        sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        sock.connect(sock_path)
+        duplex = TcpDuplex(sock, is_client=True)
+        import threading as threadingmod
+
+        replies = {}
+        got = threadingmod.Event()
+
+        def on_msg(msg):
+            if isinstance(msg, dict) and msg.get("type") == "Reply":
+                replies[msg["queryId"]] = msg.get("payload")
+                got.set()
+
+        duplex.on_message(on_msg)
+        duplex.send(
+            msgs.query_msg(
+                1,
+                msgs.read_query(
+                    doc_id, {"kind": "text", "path": ["t"]}
+                ),
+            )
+        )
+        assert got.wait(30), "no Read reply"
+        assert replies[1] == {"value": "from-hbm"}
+        got.clear()
+        duplex.send(msgs.query_msg(2, msgs.telemetry_query()))
+        assert got.wait(30), "no Telemetry reply"
+        tele = replies[2]
+        assert "serve" in tele and doc_id in tele["serve"]["resident"]
+        assert any(
+            k.startswith("serve.") for k in tele["counters"]
+        )
+        duplex.close()
+
+        # ls --sock lists the DAEMON's live residency (the in-process
+        # column would be cold); HM_RECOVER=0 because the daemon holds
+        # the dirty marker of its live session
+        out = subprocess.run(
+            [sys.executable, "tools/ls.py", path, "--sock", sock_path],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**ENV, "HM_RECOVER": "0"},
+            cwd=REPO_ROOT,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "residency=resident(" in out.stdout
+    finally:
+        serve.kill()
+        serve.wait(timeout=10)

@@ -1,8 +1,21 @@
 """List every document in a repo directory: url, actor count, clock
-total, feed bytes on disk, and per-doc crash/scrub status. (Reference
-tools/* ship six ts-node scripts; this is the inventory one.)
+total, feed bytes on disk, read-serving residency, and per-doc
+crash/scrub status. (Reference tools/* ship six ts-node scripts; this
+is the inventory one.)
 
-    python tools/ls.py /path/to/repo [--audit]
+    python tools/ls.py /path/to/repo [--audit] [--sock /tmp/serve.sock]
+
+The `residency=` column comes from the backend's Telemetry query (the
+serve block tools/top.py also sees): `resident(<bytes>B)` — the doc's
+summary columns are pinned in device memory and reads batch through
+the query kernels; `evicted` — it was resident until the
+HM_SERVE_MAX_BYTES LRU shed it (the next read reinstalls); `host` —
+reads take per-request host materialization (tier off or never read).
+Without --sock the column describes THIS in-process open — a fresh
+open has served no reads, so everything shows `host`. Point --sock at
+a RUNNING daemon's query socket (`tools/serve.py --ipc <sock>` or
+`net/ipc.py`) to list the residency the daemon is actually serving
+from.
 
 The `scrub=` column surfaces crash damage without a full scrub
 (storage/scrub.py doc_status): `ok`, `recovered` (the last crash
@@ -64,6 +77,11 @@ def main() -> None:
         "--audit", action="store_true",
         help="verify each feed's signed merkle chain",
     )
+    ap.add_argument(
+        "--sock", default=None,
+        help="query a running daemon's Telemetry socket for the LIVE "
+        "residency column (tools/serve.py --ipc / net/ipc.py)",
+    )
     args = ap.parse_args()
 
     repo = Repo(path=args.repo)
@@ -84,6 +102,7 @@ def main() -> None:
     tele_keys = (
         "storage.recoveries", "storage.fsyncs", "storage.barriers",
         "pipeline.slabs", "mesh.dispatches", "live.adopted",
+        "serve.reads", "serve.fallbacks",
     )
     tele = " ".join(
         f"{k.split('.', 1)[1]}={snap[k]}"
@@ -92,6 +111,38 @@ def main() -> None:
     )
     if tele:
         print(f"telemetry: {tele}")
+    # per-doc read-serving residency, sourced from the Telemetry query
+    # (the same payload tools/top.py polls): --sock asks the RUNNING
+    # daemon which docs it serves from HBM; otherwise the column
+    # describes this in-process open (cold => host everywhere)
+    if args.sock:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "hm_top", str(Path(__file__).resolve().parent / "top.py")
+        )
+        top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(top)
+        client = top.IpcTelemetry(args.sock)
+        try:
+            serve = client.poll().get("serve")
+        finally:
+            client.close()
+    else:
+        tq = []
+        repo.telemetry(tq.append)
+        serve = (tq[0] or {}).get("serve") if tq else None
+
+    def residency(doc_id):
+        if serve is None:
+            return "host"
+        ent = serve["resident"].get(doc_id)
+        if ent is not None:
+            return f"resident({ent['bytes']}B)"
+        if doc_id in serve["evicted"]:
+            return "evicted"
+        return "host"
+
     for doc_id in doc_ids:
         cursor = back.cursors.get(back.id, doc_id)
         clock = back.clocks.get(back.id, doc_id)
@@ -100,6 +151,7 @@ def main() -> None:
         line = (
             f"{to_doc_url(doc_id)}  actors={len(cursor)} "
             f"changes={total_changes} bytes={nbytes} "
+            f"residency={residency(doc_id)} "
             f"scrub={doc_status(back, doc_id, report)}"
         )
         if args.audit:

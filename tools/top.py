@@ -4,7 +4,10 @@ Polls the backend's ``Telemetry`` query over the IPC/serve seam
 (net/ipc.py unix socket) and renders per-subsystem counter RATES — the
 "what is this daemon doing right now" view ISSUE 9 asked for: live
 ticks/s, replication frames/s, TCP bytes/s, fsync barriers/s, mesh
-dispatches/s, pipeline queue depths.
+dispatches/s, pipeline queue depths — and, since ISSUE 11, the
+read-serving tier's serve.* block: reads/s, batched dispatches/s,
+residency hit/install/eviction rates, fallbacks/s (the [serve] group;
+`python tools/serve.py --ipc <sock>` exposes the same socket).
 
     # against a daemon (python -m hypermerge_tpu.net.ipc repo sock --persist)
     python tools/top.py --sock /tmp/backend.sock [--interval 1.0]
