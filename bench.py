@@ -197,6 +197,89 @@ def _config_mesh(tmp, n_passes=2):
     )
 
 
+def _config_lockdebt():
+    """The write-plane blocking debt, measured: a durable burst of
+    local edits across several docs on a disk-backed repo, run with
+    lockdep instrumentation on so the blocking seams (fsync, sqlite
+    commit, debouncer waits) charge their wall time to every lock
+    class held at entry. Returns the per-lock-class
+    `lock.held_blocking_ms.*` deltas (ms) for BOTH durable tiers:
+
+      fsync_group      HM_FSYNC=1 — durability debounced off-thread;
+                       the engine-lock entry shows what the emission
+                       path itself blocks on
+      fsync_per_append HM_FSYNC=2 — the inline-durability worst case:
+                       every acked append fsyncs under the emission
+                       lock
+
+    The `live_engine` entry IS the ROADMAP write-plane gate as a
+    number: feed-append / clock-commit time spent under the ONE
+    engine lock — the per-doc emission-domain split is gated on the
+    tier-1 figure reading zero and judged against the tier-2 figure
+    it must dissolve into per-doc domains."""
+    import tempfile as _tempfile
+
+    from hypermerge_tpu import telemetry
+    from hypermerge_tpu.analysis import lockdep
+    from hypermerge_tpu.repo import Repo
+
+    prefix = "lock.held_blocking_ms."
+
+    def snap():
+        return {
+            k[len(prefix):]: v
+            for k, v in telemetry.snapshot().items()
+            if k.startswith(prefix)
+        }
+
+    def burst(tier: str):
+        os.environ["HM_FSYNC"] = tier
+        tmp = _tempfile.mkdtemp(prefix="hm-lockdebt-")
+        try:
+            before = snap()
+            repo = Repo(path=os.path.join(tmp, "repo"))
+            try:
+                urls = [repo.create({"n": 0}) for _ in range(8)]
+                for i in range(40):
+                    for url in urls:
+                        repo.change(
+                            url, lambda d: d.__setitem__("n", i)
+                        )
+                back = repo.back
+                if back.live is not None:
+                    back.live.flush_now()
+                back._stores.flush_now()
+                back.durability.flush_now()
+            finally:
+                repo.close()
+            after = snap()
+            debt = {
+                k: round(after.get(k, 0.0) - before.get(k, 0.0), 3)
+                for k in after
+                if after.get(k, 0.0) - before.get(k, 0.0) > 0
+            }
+            # the gate reads zero only when the key exists to read
+            debt.setdefault("live_engine", 0.0)
+            return debt
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    was = lockdep.enabled()
+    env_fsync = os.environ.get("HM_FSYNC")
+    lockdep.enable(True)  # fresh repos below get instrumented locks
+    try:
+        return {
+            "fsync_group": burst("1"),
+            "fsync_per_append": burst("2"),
+        }
+    finally:
+        lockdep.enable(was)
+        if env_fsync is None:
+            os.environ.pop("HM_FSYNC", None)
+        else:
+            os.environ["HM_FSYNC"] = env_fsync
+
+
 def _config1_change_latency():
     """Interactive path: µs per single-op change on a live doc."""
     from hypermerge_tpu.repo import Repo
@@ -1216,6 +1299,17 @@ def main() -> None:
             f"readopted {cfg6d[1].get('readopted', 0)})",
             file=sys.stderr,
         )
+    cfgld = _soft("config_lockdebt", _config_lockdebt)
+    if cfgld is not None:
+        print(
+            f"# config_lockdebt write-plane blocking debt "
+            f"(instrumented): live.engine held across blocking calls "
+            f"{cfgld['fsync_group'].get('live_engine', 0.0):.1f}ms at "
+            f"HM_FSYNC=1, "
+            f"{cfgld['fsync_per_append'].get('live_engine', 0.0):.1f}"
+            f"ms at HM_FSYNC=2; per class {cfgld}",
+            file=sys.stderr,
+        )
     cfg3 = _soft("config3", _config3_multiactor)
     if cfg3 is not None:
         print(
@@ -1324,6 +1418,10 @@ def main() -> None:
                     "config6_demote": (
                         cfg6d[1] if cfg6d is not None else None
                     ),
+                    # per-lock-class blocking debt (ms) from the
+                    # instrumented durable burst; the `live_engine`
+                    # entry gates the ROADMAP write-plane split
+                    "lock_held_blocking_ms": cfgld,
                     "config3_multiactor_ops_per_s": (
                         round(cfg3[1]) if cfg3 is not None else None
                     ),

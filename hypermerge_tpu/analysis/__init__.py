@@ -1,14 +1,18 @@
-"""Machine-checked concurrency invariants (ISSUE 10).
+"""Machine-checked concurrency invariants (ISSUES 10 + 13).
 
 Two halves over one rule set:
 
-- `hierarchy.py` — THE lock-hierarchy manifest (ranks, leaves,
+- the manifests: `hierarchy.py` — THE lock-hierarchy (ranks, leaves,
   no-block emission locks) plus the blocking-call and engine-entry
-  tables; `envvars.py` — the HM_* env-var registry.
-- `linter.py` — the static AST pass (`python tools/lint.py`, run in
-  tier-1 by tests/test_analysis.py); `lockdep.py` — the runtime
-  detector behind `HM_LOCKDEP=1` and the `make_lock`/`make_rlock`/
-  `make_condition` factories every package lock is created through.
+  tables; `guards.py` — THE shared-state guard map (which lock guards
+  which field, GUARDED_BY-style, with declared escape classes);
+  `envvars.py` — the HM_* env-var registry.
+- the checkers: `linter.py` — the static AST pass (`python
+  tools/lint.py`, run in tier-1 by tests/test_analysis.py);
+  `lockdep.py` — the runtime detectors: `HM_LOCKDEP=1` lock-order/
+  blocking instrumentation through the `make_lock`/`make_rlock`/
+  `make_condition` factories, and `HM_RACEDEP=1` Eraser-style lockset
+  race detection over the guard manifest's attributes.
 
 `suppressions.py` holds the (justified) exceptions.
 """
@@ -17,16 +21,24 @@ from .lockdep import (  # noqa: F401
     blocking,
     enable as enable_lockdep,
     enabled as lockdep_enabled,
+    install_racedep,
     make_condition,
     make_lock,
     make_rlock,
+    maybe_install_racedep,
+    racedep_enabled,
+    uninstall_racedep,
 )
 
 __all__ = [
     "blocking",
     "enable_lockdep",
     "lockdep_enabled",
+    "install_racedep",
     "make_condition",
     "make_lock",
     "make_rlock",
+    "maybe_install_racedep",
+    "racedep_enabled",
+    "uninstall_racedep",
 ]

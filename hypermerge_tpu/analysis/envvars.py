@@ -171,6 +171,14 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "records acquisition order, reports potential deadlock "
            "cycles + held-across-blocking-call violations "
            "(analysis/lockdep.py)."),
+    EnvVar("HM_RACEDEP", "0", "=1 wraps the guard manifest's declared "
+           "attributes (analysis/guards.py) in Eraser-style lockset "
+           "descriptors: a shared field no lock consistently guards "
+           "is reported without the race firing (implies "
+           "HM_LOCKDEP)."),
+    EnvVar("HM_RACEDEP_SAMPLE", "1", "Track every Nth "
+           "(object, attribute) under HM_RACEDEP=1 (1 = all; raise "
+           "to bound overhead on huge corpora)."),
     # -- native / tools -------------------------------------------------
     EnvVar("HM_NATIVE_PACK", "1", "Native C++ pack kernel (0 = numpy "
            "twin)."),

@@ -1,0 +1,317 @@
+"""THE shared-state guard manifest — which lock guards which field.
+
+PR 9 (`hierarchy.py`) made the lock ORDER machine-checked; this module
+does the same for the DATA half of the concurrency story: every shared
+attribute of the hot concurrent classes is mapped to the lock class
+(from `analysis/hierarchy.py`) that guards it, in the tradition of
+Clang's `GUARDED_BY` thread-safety annotations. The scattered
+"guarded by the engine lock" comments those classes used to carry are
+now rows here, consumed by two checkers:
+
+- the static `guarded-attr` lint rule (`analysis/linter.py`, run by
+  `tools/lint.py` and tier-1): every `self.<attr>` read/write of a
+  declared attribute inside its class must sit lexically inside a
+  `with` of the declared guard (or inside a method listed in
+  `REQUIRES` below). Writes are hard errors; reads may be excused by
+  the `atomic_read_ok` escape.
+- the runtime lockset detector (`analysis/lockdep.py`,
+  `HM_RACEDEP=1`): the declared attributes are wrapped in descriptor
+  instrumentation that intersects per-(object, attribute) candidate
+  locksets Eraser-style against the per-thread held stacks lockdep
+  maintains — a guard violation is reported from the access pattern
+  alone, without the race ever firing, and regardless of which
+  receiver expression reached the field (the static rule only sees
+  `self.X`).
+
+Escape classes — every shared field has a DECLARED story, including
+the fields that are deliberately not lock-guarded:
+
+- (no escape)      reads AND writes require the guard.
+- `atomic_read_ok` writes require the guard; a lone read is a
+  GIL-atomic snapshot (dict.get / bool flag / int) taken on a hot
+  path on purpose. The runtime detector still tracks writes.
+- `init_only`      written only in `__init__` (before the object is
+  shared); reads need no lock. A write anywhere else is a violation.
+- `unguarded`      deliberately lock-free shared state; the `doc`
+  string IS the story (single-writer protocol, monotonic latch,
+  snapshot idiom). Not instrumented at runtime.
+
+Granularity matches GUARDED_BY: the FIELD (the reference) is guarded,
+not the object graph behind it — mutating a dict obtained from a
+guarded read is visible to the checkers only at the `self.X` access.
+`__init__` bodies are exempt everywhere (the object is not yet
+shared). Accesses through receivers other than `self` are invisible
+to the static rule but fully visible to the runtime detector.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+from .hierarchy import BY_NAME as LOCK_BY_NAME
+
+ESCAPES = ("", "atomic_read_ok", "init_only", "unguarded")
+
+
+class GuardedClass(NamedTuple):
+    cls: str      # class name (unique across the package)
+    module: str   # dotted import path (runtime instrumentation)
+    guard: str    # hierarchy lock class guarding the fields below
+    guarded: Tuple[str, ...] = ()         # reads + writes under guard
+    atomic_read_ok: Tuple[str, ...] = ()  # writes under guard only
+    init_only: Tuple[str, ...] = ()       # written in __init__ only
+    unguarded: Tuple[str, ...] = ()       # declared lock-free (doc!)
+    doc: str = ""
+
+
+GUARDS: Tuple[GuardedClass, ...] = (
+    GuardedClass(
+        "LiveApplyEngine", "hypermerge_tpu.backend.live", "live.engine",
+        guarded=(
+            "_docs", "_refused", "_adopting", "_demoted_ids",
+            "_use_clock",
+        ),
+        init_only=("_back", "_m", "_ticker"),
+        doc="The engine's doc table, refusal/adoption/demotion sets "
+            "and the LRU use-clock all mutate under the ONE emission "
+            "lock; adoption BUILDS run lock-free but install under it "
+            "with a recheck (the PR-4 idiom).",
+    ),
+    GuardedClass(
+        "DocBackend", "hypermerge_tpu.backend.doc_backend", "doc",
+        guarded=(
+            "_lazy_loader", "_lazy_clock", "_lazy_len", "_snapshot_fn",
+            "_snapshot_cache", "_replay_cache", "minimum_clock",
+            "_live_adopted",
+        ),
+        atomic_read_ok=("opset", "_announced", "actor_id"),
+        init_only=("id", "_notify", "_live", "ready", "local_q",
+                   "remote_q"),
+        doc="Per-doc CRDT/lazy state under the doc lock. `opset` and "
+            "`_announced` transition once (None->OpSet, "
+            "False->True) and are snapshot-read on the hot dispatch "
+            "paths before taking any lock; `actor_id` is snapshot-read "
+            "by Ready emissions (engine/emit lock held, doc lock not).",
+    ),
+    GuardedClass(
+        "RepoBackend", "hypermerge_tpu.backend.repo_backend", "repo",
+        guarded=("_bulk_deferred_syncs", "_bulk_feed_rows"),
+        atomic_read_ok=("docs", "actors"),
+        init_only=(
+            "path", "memory", "durability", "db", "clocks", "cursors",
+            "key_store", "feed_info", "feeds", "id", "meta",
+            "to_frontend", "recovery_report", "_dirty_marker",
+            "_col_slab", "_query_handlers", "_gossip",
+            "_syncs", "_cache_syncs", "_stores", "_store_debounce",
+            "_gossip_fresh", "live", "serve",
+        ),
+        unguarded=("network", "file_store", "_file_server", "_closed",
+                   "_actor_keys"),
+        doc="docs/actors mutate under the repo lock; lookups are "
+            "GIL-atomic dict.get snapshots on the receive/query hot "
+            "paths. `network`/`file_store`/`_file_server` are "
+            "set-once wiring installed before traffic flows; "
+            "`_closed` is a monotonic shutdown latch; `_actor_keys` "
+            "mirrors the sqlite keys table (insert-once per actor, "
+            "GIL-atomic dict ops, sqlite is the durable truth).",
+    ),
+    GuardedClass(
+        "RepoBackend(bulk)", "hypermerge_tpu.backend.repo_backend",
+        "repo.bulk",
+        guarded=("_pending_memo", "_bulk_t0", "_fetch_ctx",
+                 "_summary_memo_bytes"),
+        atomic_read_ok=("_summary_memo",),
+        unguarded=(
+            "_pending_summaries", "_rr_cached", "_rr_value",
+            "_mesh_cached", "_mesh_value",
+        ),
+        doc="Bulk-load accumulators: one load at a time under "
+            "repo.bulk (the barrier, fetch_bulk_summaries, takes it "
+            "too). `_summary_memo` is read lock-free by pipeline "
+            "classify and serve installs (GIL-atomic dict.get); "
+            "`_pending_summaries` is appended by pipeline stage "
+            "threads (GIL-atomic) and swapped whole under repo.bulk "
+            "after the stage barrier joined them; the scheduler/mesh "
+            "caches build once, idempotently, on first use.",
+    ),
+    GuardedClass(
+        "RepoBackend(stats)", "hypermerge_tpu.backend.repo_backend",
+        "repo.stats",
+        atomic_read_ok=("last_bulk_stats",),
+        doc="Stage timings accumulate from pipeline worker threads "
+            "under repo.stats (_stat_add); bench/tools read the dict "
+            "lock-free after the load settled.",
+    ),
+    GuardedClass(
+        "ReadBatcher", "hypermerge_tpu.serve.batcher", "serve.batch",
+        guarded=("_seq", "_closed"),
+        atomic_read_ok=("_depth",),
+        init_only=("_flush", "_cap", "_deb"),
+        doc="Admission accounting under serve.batch; `depth` is a "
+            "monitoring snapshot read.",
+    ),
+    GuardedClass(
+        "ResidencyCache", "hypermerge_tpu.serve.resident", "serve.cache",
+        guarded=("_entries", "_evicted", "_use"),
+        atomic_read_ok=("_bytes",),
+        doc="The residency table mutates under serve.cache only "
+            "(builds/uploads run outside it); `resident_bytes` is a "
+            "monitoring snapshot read.",
+    ),
+    GuardedClass(
+        "SessionSupervisor", "hypermerge_tpu.net.resilience", "net.sup",
+        guarded=("_sessions",),
+        atomic_read_ok=("_stopped",),
+        init_only=("_dial", "_deliver", "_banned", "_m"),
+        unguarded=("_on_status",),
+        doc="The outbound session table mutates under net.sup; "
+            "`_stopped` is polled lock-free by every session thread's "
+            "redial loop. `_on_status` is a set-once hook registered "
+            "before sessions start.",
+    ),
+    GuardedClass(
+        "NetworkPeer", "hypermerge_tpu.net.peer", "net.peer",
+        guarded=("_pending",),
+        init_only=("self_id", "id", "_on_active", "_on_inactive"),
+        unguarded=("connection",),
+        doc="`_pending` mutates under net.peer (accept/supervisor "
+            "threads vs close-driven prunes). `connection` is the "
+            "DOCUMENTED snapshot idiom: it can flip to None under "
+            "churn, so every consumer snapshots it once "
+            "(NetworkPeer.try_send) instead of check-then-use.",
+    ),
+    GuardedClass(
+        "CursorStore", "hypermerge_tpu.storage.stores", "store.cursors",
+        guarded=("_mem", "_by_actor", "_del_gen"),
+        atomic_read_ok=("_hydrated",),
+        init_only=("db",),
+        doc="The write-through cursor mirror mutates under "
+            "store.cursors; `_hydrated` membership is the documented "
+            "GIL-atomic fast path of _ensure_hydrated (writes merge "
+            "under the lock).",
+    ),
+    GuardedClass(
+        "DurabilityManager", "hypermerge_tpu.storage.durability",
+        "store.durability",
+        guarded=("_dirty", "_closed"),
+        atomic_read_ok=("_flusher",),
+        doc="The tier-1 dirty set and shutdown latch mutate under "
+            "store.durability; flush_now snapshots the flusher handle "
+            "lock-free (it is installed once and cleared at close).",
+    ),
+)
+
+# Methods whose WHOLE BODY runs with the named lock held — the Clang
+# `REQUIRES` annotation as manifest data. Every caller acquires the
+# lock; the static rule treats the body as a held region. (The runtime
+# detector needs no such hint: it sees the actual held stack.)
+REQUIRES: Dict[Tuple[str, str], str] = {
+    ("LiveApplyEngine", "_bump_use"): "live.engine",
+    ("LiveApplyEngine", "_flush_ids"): "live.engine",
+    ("LiveApplyEngine", "_enforce_budget_locked"): "live.engine",
+    ("LiveApplyEngine", "_demote_pass"): "live.engine",
+    ("LiveApplyEngine", "_demote_locked"): "live.engine",
+    ("LiveApplyEngine", "_evict_to_host"): "live.engine",
+    ("DocBackend", "_minimum_satisfied"): "doc",
+    ("RepoBackend", "_load_documents_bulk_locked"): "repo.bulk",
+    ("RepoBackend", "_load_slabs_serial"): "repo.bulk",
+    ("RepoBackend", "_load_slabs_pipelined"): "repo.bulk",
+    ("RepoBackend", "_memoize_summaries"): "repo.bulk",
+    ("ResidencyCache", "_note_evicted"): "serve.cache",
+    ("CursorStore", "_repo"): "store.cursors",
+    ("CursorStore", "_absorb"): "store.cursors",
+}
+
+
+class AttrGuard(NamedTuple):
+    cls: str
+    module: str
+    guard: str
+    attr: str
+    escape: str  # "", "atomic_read_ok", "init_only", "unguarded"
+
+
+def _flatten() -> Dict[Tuple[str, str], AttrGuard]:
+    out: Dict[Tuple[str, str], AttrGuard] = {}
+    for gc in GUARDS:
+        # "RepoBackend(bulk)" style rows split ONE class's fields
+        # across guards; the real class name precedes the "("
+        cls = gc.cls.split("(", 1)[0]
+        for escape, attrs in (
+            ("", gc.guarded),
+            ("atomic_read_ok", gc.atomic_read_ok),
+            ("init_only", gc.init_only),
+            ("unguarded", gc.unguarded),
+        ):
+            for attr in attrs:
+                key = (cls, attr)
+                if key in out:
+                    raise ValueError(
+                        f"duplicate guard entry for {cls}.{attr}"
+                    )
+                out[key] = AttrGuard(cls, gc.module, gc.guard, attr,
+                                     escape)
+    return out
+
+
+BY_CLS_ATTR: Dict[Tuple[str, str], AttrGuard] = _flatten()
+CLASSES: Tuple[str, ...] = tuple(
+    sorted({cls for cls, _attr in BY_CLS_ATTR})
+)
+
+
+def guard_for(cls: str, attr: str) -> Optional[AttrGuard]:
+    """The declared guard entry for (class, attribute), or None."""
+    return BY_CLS_ATTR.get((cls, attr))
+
+
+def validate() -> None:
+    """Manifest self-check (run by tests): guards declared in the
+    lock hierarchy, REQUIRES targets sane, no duplicate fields."""
+    for gc in GUARDS:
+        if gc.guard not in LOCK_BY_NAME:
+            raise ValueError(
+                f"{gc.cls}: guard {gc.guard!r} is not a lock class "
+                f"declared in analysis/hierarchy.py"
+            )
+        if not gc.module.startswith("hypermerge_tpu."):
+            raise ValueError(f"{gc.cls}: module {gc.module!r} outside "
+                             f"the package")
+        if gc.unguarded and not gc.doc.strip():
+            raise ValueError(
+                f"{gc.cls}: unguarded fields need the story in doc"
+            )
+    _flatten()  # raises on duplicates
+    for (cls, _method), lock in REQUIRES.items():
+        if lock not in LOCK_BY_NAME:
+            raise ValueError(
+                f"REQUIRES[{cls}]: unknown lock class {lock!r}"
+            )
+        if not any(c.split("(", 1)[0] == cls for c in
+                   (g.cls for g in GUARDS)):
+            raise ValueError(
+                f"REQUIRES names class {cls!r} absent from GUARDS"
+            )
+
+
+def markdown_table() -> str:
+    """The README guard-map table (tools/lint.py --guards-table)."""
+    lines = [
+        "| Class | Guard | Escape | Fields |",
+        "| --- | --- | --- | --- |",
+    ]
+    for gc in GUARDS:
+        cls = gc.cls.split("(", 1)[0]
+        for escape, attrs in (
+            ("—", gc.guarded),
+            ("atomic_read_ok", gc.atomic_read_ok),
+            ("init_only", gc.init_only),
+            ("unguarded", gc.unguarded),
+        ):
+            if not attrs:
+                continue
+            fields = ", ".join(f"`{a}`" for a in attrs)
+            lines.append(
+                f"| `{cls}` | `{gc.guard}` | {escape} | {fields} |"
+            )
+    return "\n".join(lines)

@@ -32,6 +32,16 @@ bench.py, __graft_entry__.py), driven by the declared rule data in
   make_rlock / make_condition` (with a class declared in the
   manifest), so runtime lockdep sees every lock. Bare test/analysis
   code is exempt.
+- **guarded-attr** — every `self.<attr>` read/write of an attribute
+  declared in the guard manifest (`analysis/guards.py`, the
+  GUARDED_BY map) must sit lexically inside a `with` of its declared
+  guard or inside a `guards.REQUIRES` method. Writes are hard errors;
+  reads may satisfy the `atomic_read_ok` escape; `init_only` fields
+  flag any write outside `__init__`. Tree runs also flag stale
+  manifest entries and classes missing from the README guard table.
+  The runtime half (`HM_RACEDEP=1` lockset descriptors) covers the
+  non-`self` receivers and interprocedural flows this lexical rule
+  cannot see.
 
 Suppression requires a justification, either inline —
 
@@ -50,6 +60,7 @@ import os
 import re
 from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
+from . import guards as guardsmod
 from . import suppressions as suppmod
 from .envvars import BY_NAME as ENV_BY_NAME, REGISTRY as ENV_REGISTRY
 from .hierarchy import (
@@ -69,7 +80,20 @@ RULES = (
     "env-registry",
     "telemetry-name",
     "raw-lock",
+    "guarded-attr",
     "suppression",
+)
+
+# method names that MUTATE the container a guarded field holds — for
+# the guarded-attr rule, `self._docs.pop(...)` is a WRITE to the
+# field's state, not a read (field-level granularity would otherwise
+# let `atomic_read_ok` excuse a lock-free mutation)
+_MUTATORS = frozenset(
+    {
+        "append", "appendleft", "add", "pop", "popitem", "clear",
+        "remove", "discard", "insert", "extend", "setdefault",
+        "move_to_end",
+    }
 )
 
 _NAME_RE = TELEMETRY_NAME_RE
@@ -318,6 +342,7 @@ class _FileLinter(ast.NodeVisitor):
         table: _LockTable,
         out: List[Violation],
         env_reads: Dict[str, List[Tuple[str, int, Optional[str]]]],
+        guard_seen: Optional[Set[Tuple[str, str]]] = None,
     ) -> None:
         self.rel = rel
         self.relu = rel.replace(os.sep, "/")
@@ -325,12 +350,18 @@ class _FileLinter(ast.NodeVisitor):
         self.table = table
         self.out = out
         self.env_reads = env_reads
+        self.guard_seen = guard_seen if guard_seen is not None else set()
         self.cls_stack: List[str] = []
         # (class name or None, line) per enclosing `with` item that
         # resolved to a tracked lock
         self.with_stack: List[Tuple[Optional[str], int]] = []
         self.fn_depth_at_with: List[int] = []
         self.fn_depth = 0
+        self.fn_stack: List[str] = []
+        # guarded-attr: self.<attr> nodes already classified as writes
+        # (assignment targets, mutator receivers) — visit_Attribute
+        # must not re-classify them as reads
+        self._guard_done: Set[int] = set()
         self.in_pkg = _in_package(rel)
         self.is_peer = self.relu.endswith("net/peer.py")
         self.is_analysis = "/analysis/" in "/" + self.relu
@@ -350,9 +381,27 @@ class _FileLinter(ast.NodeVisitor):
         self.cls_stack.pop()
 
     def _visit_fn(self, node) -> None:
+        name = getattr(node, "name", "<lambda>")
+        self.fn_stack.append(name)
         self.fn_depth += 1
+        # a method listed in guards.REQUIRES runs its WHOLE body with
+        # the named lock held (every caller acquires it — the Clang
+        # REQUIRES annotation as manifest data); nested defs still
+        # start from an empty held set (they may run on any thread)
+        req = (
+            guardsmod.REQUIRES.get((self.cls_stack[-1], name))
+            if self.cls_stack
+            else None
+        )
+        if req is not None:
+            self.with_stack.append((req, node.lineno))
+            self.fn_depth_at_with.append(self.fn_depth)
         self.generic_visit(node)
+        if req is not None:
+            self.with_stack.pop()
+            self.fn_depth_at_with.pop()
         self.fn_depth -= 1
+        self.fn_stack.pop()
 
     visit_FunctionDef = _visit_fn
     visit_AsyncFunctionDef = _visit_fn
@@ -413,10 +462,144 @@ class _FileLinter(ast.NodeVisitor):
                         f"(analysis/hierarchy.py)",
                     )
 
+    # -- guarded-attr (analysis/guards.py) -----------------------------
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> Optional[ast.Attribute]:
+        """The `self.<attr>` Attribute node under zero or more
+        subscripts (`self.x`, `self.x[k]`, `self.x[k][j]`)."""
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            return node
+        return None
+
+    def _collect_target_attrs(
+        self, tgt: ast.AST, out: List[ast.Attribute]
+    ) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._collect_target_attrs(el, out)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._collect_target_attrs(tgt.value, out)
+            return
+        a = self._self_attr(tgt)
+        if a is not None:
+            out.append(a)
+
+    def _guard_access(self, attr_node: ast.Attribute, write: bool) -> None:
+        """Check one `self.<attr>` access against the guard manifest
+        (the `guarded-attr` rule). Writes are hard errors outside the
+        declared guard; reads may be excused by `atomic_read_ok`."""
+        self._guard_done.add(id(attr_node))
+        if not self.in_pkg or not self.cls_stack:
+            return
+        cls = self.cls_stack[-1]
+        entry = guardsmod.guard_for(cls, attr_node.attr)
+        if entry is None:
+            return
+        self.guard_seen.add((cls, attr_node.attr))
+        if "__init__" in self.fn_stack:
+            return  # not shared yet: constructor writes are exempt
+        if entry.escape == "unguarded":
+            return
+        line = attr_node.lineno
+        if entry.escape == "init_only":
+            if write:
+                self.hit(
+                    "guarded-attr", line,
+                    f"writes init-only field {cls}.{attr_node.attr} "
+                    f"outside __init__ (analysis/guards.py)",
+                )
+            return
+        held = {h for h, _ln in self._held() if h is not None}
+        if entry.guard in held:
+            return
+        if write:
+            self.hit(
+                "guarded-attr", line,
+                f"writes {cls}.{attr_node.attr} outside a `with` of "
+                f"its declared guard {entry.guard!r} "
+                f"(analysis/guards.py)",
+            )
+        elif entry.escape != "atomic_read_ok":
+            self.hit(
+                "guarded-attr", line,
+                f"reads {cls}.{attr_node.attr} outside a `with` of "
+                f"its declared guard {entry.guard!r} — take the lock, "
+                f"or declare the read atomic_read_ok in "
+                f"analysis/guards.py",
+            )
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        attrs: List[ast.Attribute] = []
+        for tgt in node.targets:
+            self._collect_target_attrs(tgt, attrs)
+        for a in attrs:
+            self._guard_access(a, write=True)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        a = self._self_attr(node.target)
+        if a is not None:
+            self._guard_access(a, write=True)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        a = self._self_attr(node.target)
+        if a is not None and node.value is not None:
+            self._guard_access(a, write=True)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            a = self._self_attr(tgt)
+            if a is not None:
+                self._guard_access(a, write=True)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if id(node) not in self._guard_done:
+            a = self._self_attr(node)
+            if a is node:
+                self._guard_access(node, write=not isinstance(
+                    node.ctx, ast.Load
+                ))
+        self.generic_visit(node)
+
     # -- calls ---------------------------------------------------------
 
     def visit_Call(self, node: ast.Call) -> None:
         name = _call_name(node)
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _MUTATORS
+            and isinstance(fn.value, ast.Attribute)
+        ):
+            # mutating the container a guarded field holds IS a write
+            # to the guarded state. Direct receivers only: an element
+            # access (`self._m[k].add(1)`) reaches a DIFFERENT object
+            # (field-level granularity), and init_only/unguarded
+            # fields hold service objects whose API may collide with
+            # container-mutator names — their story is rebinding, not
+            # content.
+            a = self._self_attr(fn.value)
+            if a is not None:
+                entry = (
+                    guardsmod.guard_for(self.cls_stack[-1], a.attr)
+                    if self.cls_stack
+                    else None
+                )
+                if entry is not None and entry.escape in (
+                    "", "atomic_read_ok"
+                ):
+                    self._guard_access(a, write=True)
         if self.in_pkg:
             self._rule_raw_lock(node, name)
             self._rule_churn_send(node, name)
@@ -696,10 +879,14 @@ def lint_files(
             table.learn(rel, tree)
         parsed.append((rel, tree, src))
     env_reads: Dict[str, List[Tuple[str, int, Optional[str]]]] = {}
+    guard_seen: Set[Tuple[str, str]] = set()
     for rel, tree, src in parsed:
-        _FileLinter(rel, src, table, out, env_reads).visit(tree)
+        _FileLinter(rel, src, table, out, env_reads, guard_seen).visit(
+            tree
+        )
     if whole_tree:
         _check_env_registry(out, env_reads, root)
+        _check_guards_registry(out, guard_seen, root)
     return _apply_suppressions(out, sources)
 
 
@@ -763,3 +950,46 @@ def _check_env_registry(
                     False,
                 )
             )
+
+
+def _check_guards_registry(
+    out: List[Violation], guard_seen: Set[Tuple[str, str]], root: str
+) -> None:
+    """Tree-wide guard-manifest hygiene (whole-tree runs only): an
+    entry no `self.<attr>` access matches is stale (renamed/deleted
+    field rots silently otherwise), and every row of the generated
+    guard-map table must appear verbatim in the README (the
+    --guards-table mirror of the env-table drift rule; a row check —
+    not a class-name check — so moving a field between escape
+    classes without regenerating is also drift)."""
+    for (cls, attr) in sorted(guardsmod.BY_CLS_ATTR):
+        if (cls, attr) not in guard_seen:
+            out.append(
+                Violation(
+                    "guarded-attr",
+                    "hypermerge_tpu/analysis/guards.py", 1,
+                    f"stale guard entry {cls}.{attr}: no such "
+                    f"attribute access in the tree — delete it or fix "
+                    f"the name",
+                    False,
+                )
+            )
+    readme = ""
+    try:
+        with open(os.path.join(root, "README.md"), encoding="utf-8") as fh:
+            readme = fh.read()
+    except OSError:
+        pass
+    if readme:
+        for row in guardsmod.markdown_table().splitlines()[2:]:
+            if row not in readme:
+                out.append(
+                    Violation(
+                        "guarded-attr",
+                        "hypermerge_tpu/analysis/guards.py", 1,
+                        f"README guard-map table is missing the row "
+                        f"{row!r} (regenerate with "
+                        f"`python tools/lint.py --guards-table`)",
+                        False,
+                    )
+                )

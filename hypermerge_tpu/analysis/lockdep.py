@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -47,6 +48,10 @@ from .hierarchy import ALLOWED_EDGES, BY_NAME, LEAVES, NO_BLOCK, RANKED
 _MAX_REPORTS = 200  # bound memory on a pathological run
 
 _enabled = os.environ.get("HM_LOCKDEP", "0") == "1"
+# HM_RACEDEP=1: Eraser-style lockset race detection over the guard
+# manifest (analysis/guards.py) — see the "racedep" section below.
+# Implies lockdep (the per-thread held stacks ARE the lockset input).
+_race_enabled = os.environ.get("HM_RACEDEP", "0") == "1"
 
 
 def enabled() -> bool:
@@ -324,29 +329,332 @@ def make_condition(name: str, lock=None):
 # blocking seams
 
 
-def blocking(kind: str, detail: str = "") -> None:
+class _NoopSeam:
+    """Shared do-nothing seam (lockdep off / nothing held)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP_SEAM = _NoopSeam()
+
+# per-lock-class blocking-debt counters (`lock.held_blocking_ms.<cls>`
+# with the class dots flattened): the time the package spent inside a
+# blocking primitive while HOLDING each lock class. This is the
+# ROADMAP write-plane gate as a NUMBER — the feed-append/clock-commit
+# debt under `live.engine` must read zero before the per-doc emission
+# split lands. Lazy telemetry import: registry.py imports this module.
+_blk_handles: Dict[str, Any] = {}
+
+
+def _blk_counter(cls_name: str):
+    h = _blk_handles.get(cls_name)
+    if h is None:
+        from .. import telemetry
+
+        h = _blk_handles[cls_name] = telemetry.counter(
+            "lock.held_blocking_ms." + cls_name.replace(".", "_")
+        )
+    return h
+
+
+class _BlockingSeam:
+    """Times one blocking primitive and charges the wall to every lock
+    class the calling thread held at entry."""
+
+    __slots__ = ("classes", "t0")
+
+    def __init__(self, classes: Tuple[str, ...]) -> None:
+        self.classes = classes
+        self.t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc) -> None:
+        dt_ms = (time.perf_counter() - self.t0) * 1e3
+        for c in self.classes:
+            _blk_counter(c).add(dt_ms)
+
+
+def blocking(kind: str, detail: str = ""):
     """Called from the package's blocking primitives (fsync, sqlite
     commit, socket sendall, joins, first-waits). With lockdep on,
     reaching one while holding a no-block class (the emission locks)
-    is recorded as a held-across-blocking-call violation."""
+    is recorded as a held-across-blocking-call violation.
+
+    Returns a context manager: seams that wrap the blocking operation
+    in `with blocking(...)` additionally accumulate its wall time into
+    the per-held-lock-class `lock.held_blocking_ms.*` counters (the
+    write-plane blocking-debt series bench/top/BASELINE track). A bare
+    call keeps the violation check only."""
     if not _enabled:
-        return
+        return _NOOP_SEAM
     held = getattr(_tls, "held", None)
     if not held:
-        return
+        return _NOOP_SEAM
+    noblock = False
     for hname, _inst, _cnt in held:
         if hname in NO_BLOCK:
+            noblock = True
             _record_violation(
                 "blocking",
                 ("blocking", hname, kind),
                 f"blocking call {kind!r}{f' ({detail})' if detail else ''}"
                 f" while holding no-block lock {hname!r}",
             )
+    if noblock:
+        from .. import telemetry
+
+        telemetry.instant("lock.held_blocking", cat="lock")
+    return _BlockingSeam(
+        tuple(dict.fromkeys(h[0] for h in held))
+    )
 
 
 def held_classes() -> List[str]:
     """Lock classes the CURRENT thread holds (debug aid)."""
     return [e[0] for e in getattr(_tls, "held", ())]
+
+
+# ---------------------------------------------------------------------------
+# racedep (HM_RACEDEP=1): Eraser lockset detection over the guard
+# manifest. Every non-`unguarded` attribute declared in
+# analysis/guards.py is wrapped in a data descriptor; each access
+# intersects the per-(object, attribute) candidate lockset with the
+# accessing thread's held stack. The Eraser state machine: an
+# attribute starts EXCLUSIVE to its creating thread (no refinement —
+# init writes hold nothing, by design); the first access from a
+# SECOND thread starts the candidate set at that thread's held locks;
+# every later access intersects. An empty candidate set once the
+# attribute is written-while-shared means NO lock consistently guards
+# it — reported with the first shared-access site AND the violating
+# site, without the race ever firing. `atomic_read_ok` attributes
+# track writes only (their lone reads are declared GIL-atomic);
+# `init_only` attributes report any write once a second thread has
+# touched the object.
+
+
+class _AttrTrack:
+    __slots__ = ("owner", "state", "lockset", "first_site", "reported")
+
+    EXCL, SHARED, SHARED_MOD = 0, 1, 2
+
+    def __init__(self, owner: int) -> None:
+        self.owner = owner
+        self.state = _AttrTrack.EXCL
+        self.lockset: Optional[set] = None
+        self.first_site = ""
+        self.reported = False
+
+
+_race_lock = threading.Lock()  # guards every _AttrTrack transition;
+# inner order is _race_lock -> _state.lock (never reversed)
+_race_n = 0
+_race_sample_n = 1
+_race_installed: List[Tuple[type, str]] = []
+_SKIP = object()  # sampled-out marker
+
+
+def _race_sample() -> int:
+    try:
+        return max(1, int(os.environ.get("HM_RACEDEP_SAMPLE", "1")))
+    except ValueError:
+        return 1
+
+
+class _RaceAttr:
+    """Data descriptor wrapping one declared guarded attribute. The
+    value itself still lives in the instance `__dict__` (the
+    descriptor shadows it for lookups), so instrumented objects keep
+    their exact state and uninstalling restores plain access."""
+
+    __slots__ = ("cls", "attr", "guard", "escape", "skey")
+
+    def __init__(self, cls: str, attr: str, guard: str,
+                 escape: str) -> None:
+        self.cls = cls
+        self.attr = attr
+        self.guard = guard
+        self.escape = escape
+        self.skey = "_racedep__" + attr
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            val = obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(
+                f"{type(obj).__name__!r} object has no attribute "
+                f"{self.attr!r}"
+            ) from None
+        # declared-atomic reads and init-only reads are free; guarded
+        # reads participate in the lockset
+        if _race_enabled and self.escape == "":
+            _race_access(self, obj, write=False)
+        return val
+
+    def __set__(self, obj, value) -> None:
+        obj.__dict__[self.attr] = value
+        if _race_enabled:
+            _race_access(self, obj, write=True)
+
+    def __delete__(self, obj) -> None:
+        try:
+            del obj.__dict__[self.attr]
+        except KeyError:
+            raise AttributeError(self.attr) from None
+        if _race_enabled:
+            _race_access(self, obj, write=True)
+
+
+def _race_access(desc: _RaceAttr, obj, write: bool) -> None:
+    ident = threading.get_ident()
+    held = frozenset(e[0] for e in getattr(_tls, "held", ()))
+    hit = False
+    with _race_lock:
+        tr = obj.__dict__.get(desc.skey)
+        if tr is _SKIP:
+            return
+        if tr is None:
+            global _race_n
+            _race_n += 1
+            if _race_sample_n > 1 and (_race_n % _race_sample_n):
+                obj.__dict__[desc.skey] = _SKIP
+                return
+            obj.__dict__[desc.skey] = _AttrTrack(ident)
+            return
+        if tr.reported:
+            return
+        if tr.state == _AttrTrack.EXCL:
+            if tr.owner == ident:
+                return
+            # second thread: refinement begins (Eraser's
+            # exclusive->shared transition)
+            if desc.escape == "init_only":
+                if write:
+                    hit = _race_report(desc, tr, held, write)
+                return
+            tr.lockset = set(held)
+            tr.state = (
+                _AttrTrack.SHARED_MOD if write else _AttrTrack.SHARED
+            )
+            # drop _site/_race_access/__get__|__set__ so the witness
+            # is the accessing code line
+            tr.first_site = _site(skip=3)
+        else:
+            if desc.escape == "init_only":
+                if write:
+                    hit = _race_report(desc, tr, held, write)
+                return
+            tr.lockset &= held
+            if write:
+                tr.state = _AttrTrack.SHARED_MOD
+        if tr.state == _AttrTrack.SHARED_MOD and not tr.lockset:
+            hit = _race_report(desc, tr, held, write)
+    if hit:
+        from .. import telemetry
+
+        telemetry.counter("lock.racedep_violations").add(1)
+        telemetry.instant("lock.racedep_violation", cat="lock")
+
+
+def _race_report(
+    desc: _RaceAttr, tr: _AttrTrack, held: frozenset, write: bool
+) -> bool:
+    """Record one lockset violation (caller holds _race_lock). True
+    when it was newly recorded (kind+class+attr dedup)."""
+    tr.reported = True
+    if desc.escape == "init_only":
+        kind, why = "lockset", (
+            f"init-only field {desc.cls}.{desc.attr} written after "
+            f"the object was shared across threads"
+        )
+    else:
+        kind, why = "lockset", (
+            f"{desc.cls}.{desc.attr} (declared guard {desc.guard!r}): "
+            f"candidate lockset is EMPTY — no lock consistently "
+            f"guards it. This {'write' if write else 'read'} holds "
+            f"{sorted(held) or 'no locks'}; first shared access at "
+            f"{tr.first_site or '<exclusive phase>'}"
+        )
+    key = ("lockset", desc.cls, desc.attr)
+    before = len(_state.violations)
+    _record_violation(kind, key, why)
+    return len(_state.violations) != before
+
+
+def racedep_enabled() -> bool:
+    return _race_enabled
+
+
+def install_racedep() -> int:
+    """Instrument every non-`unguarded` attribute of the guard
+    manifest's classes (analysis/guards.py) with lockset descriptors.
+    Idempotent; returns the number of attributes wrapped. Enables
+    lockdep too — the per-thread held stacks are the lockset input,
+    so only factory-made locks created AFTER this call participate
+    (enable before constructing the repos under test, exactly like
+    lockdep)."""
+    global _race_enabled, _race_sample_n
+    import importlib
+
+    from . import guards
+
+    enable(True)
+    _race_enabled = True
+    _race_sample_n = _race_sample()
+    wrapped = {(c, a) for c, a in _race_installed}
+    n = 0
+    for (cls_name, attr), entry in sorted(guards.BY_CLS_ATTR.items()):
+        if entry.escape == "unguarded":
+            continue
+        mod = importlib.import_module(entry.module)
+        cls = getattr(mod, cls_name)
+        if (cls, attr) in wrapped:
+            continue
+        cur = cls.__dict__.get(attr)
+        if isinstance(cur, _RaceAttr):
+            continue
+        if cur is not None:
+            raise ValueError(
+                f"guard manifest names {cls_name}.{attr} but the class "
+                f"defines it at class level (property/default) — "
+                f"racedep can only wrap instance attributes"
+            )
+        setattr(
+            cls, attr, _RaceAttr(cls_name, attr, entry.guard,
+                                 entry.escape)
+        )
+        _race_installed.append((cls, attr))
+        n += 1
+    return n
+
+
+def uninstall_racedep() -> None:
+    """Remove the descriptors (test teardown): instance values were
+    always stored in `__dict__`, so plain attribute access resumes."""
+    global _race_enabled
+    _race_enabled = False
+    for cls, attr in _race_installed:
+        try:
+            delattr(cls, attr)
+        except AttributeError:
+            pass
+    _race_installed.clear()
+
+
+def maybe_install_racedep() -> None:
+    """HM_RACEDEP=1 activation hook (called from RepoBackend
+    construction — a daemon or bench run needs no test fixture)."""
+    if os.environ.get("HM_RACEDEP", "0") == "1" and not _race_installed:
+        install_racedep()
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,10 @@ class DocBackend:
     ) -> None:
         self.id = doc_id
         self._notify = notify
+        # which of this class's fields the doc lock guards — and which
+        # reads are declared GIL-atomic snapshots (opset/_announced/
+        # actor_id) — is manifest data now: analysis/guards.py, checked
+        # statically (guarded-attr) and at runtime (HM_RACEDEP=1)
         self._lock = make_rlock("doc")
         # `doc.emit` in the declared lock hierarchy
         # (analysis/hierarchy.py): serializes {compute patch -> push}
@@ -292,6 +296,8 @@ class DocBackend:
     # ------------------------------------------------------------------
 
     def _minimum_satisfied(self) -> bool:
+        # REQUIRES doc (analysis/guards.py): _check_ready calls in
+        # under the doc lock
         if self.opset is None and self._lazy_clock is None:
             return False
         if self.minimum_clock is None:

@@ -655,7 +655,10 @@ class LiveApplyEngine:
         # builder thread before its _LiveDoc is registered).
         self._adopting: Dict[str, _AdoptGate] = {}
         self._demoted_ids: Set[str] = set()  # for the readopted stat
-        self._use_clock = 0  # monotone LRU counter (engine lock)
+        self._use_clock = 0  # monotone LRU counter — guarded by
+        # live.engine like every field of this class: THE guard map
+        # is analysis/guards.py (machine-checked by the guarded-attr
+        # lint rule and the HM_RACEDEP=1 lockset detector)
         # stats live on the PROCESS telemetry registry (ISSUE 9): one
         # labeled series per engine so concurrent repos stay exact,
         # per-thread sharded adds so no bump needs the engine lock,
@@ -839,7 +842,8 @@ class LiveApplyEngine:
     # adoption (lock-free build + install-and-recheck)
 
     def _bump_use(self) -> int:
-        """Next LRU use-clock value. Caller holds the engine lock."""
+        """Next LRU use-clock value. REQUIRES live.engine
+        (analysis/guards.py) — callers hold the engine lock."""
         self._use_clock += 1
         return self._use_clock
 
@@ -1022,7 +1026,7 @@ class LiveApplyEngine:
         single hot doc larger than the cap must not thrash an O(doc)
         adopt/demote cycle on every tick — so the effective floor is
         one doc's bytes. Dirty docs (queued/pending changes) wait for
-        their tick. Caller holds the engine lock."""
+        their tick. REQUIRES live.engine (analysis/guards.py)."""
         cap = _live_max_bytes()
         if cap <= 0:
             self._m["live_docs"].set(len(self._docs))
@@ -1049,7 +1053,7 @@ class LiveApplyEngine:
     def _demote_pass(self, cap: int, protect_mru: bool) -> int:
         """ONE LRU demotion sweep shared by the per-tick budget pass
         (protect_mru=True) and the explicit demote_idle hook; returns
-        the number demoted. Caller holds the engine lock."""
+        the number demoted. REQUIRES live.engine (analysis/guards.py)."""
         docs = self._docs
         sizes = {i: ld.resident_bytes() for i, ld in docs.items()}
         total = sum(sizes.values())
@@ -1104,7 +1108,7 @@ class LiveApplyEngine:
         doc's next live change re-adopts from the sidecars (cheap: the
         vectorized decode). Reads keep working — a fresh lazy snapshot
         closure replaces the engine's state for Ready/reopen. Caller
-        holds the engine lock."""
+        holds the engine lock (REQUIRES live.engine, analysis/guards.py)."""
         doc = ld.doc
         log("live", f"demoting {doc.id[:6]} to lazy (LRU)")
         telemetry.instant("live.demote", cat="live")
@@ -1175,8 +1179,8 @@ class LiveApplyEngine:
         (the ROADMAP'd row-delta constant: a trickle of edits must not
         pay an O(doc) kernel+decode+diff per tick). Big catch-up ticks
         (ops x rows over the budget) take the shape-bucketed kernel
-        dispatch, where the vectorized rebuild amortizes. Caller holds
-        the engine lock."""
+        dispatch, where the vectorized rebuild amortizes. REQUIRES
+        live.engine (analysis/guards.py)."""
         now = time.perf_counter
         dirty = [
             self._docs[d]

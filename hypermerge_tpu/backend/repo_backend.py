@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..analysis.lockdep import make_lock, make_rlock
+from ..analysis.lockdep import make_lock, make_rlock, maybe_install_racedep
 from .. import msgs
 from ..crdt import clock as clockmod
 from ..crdt.change import Change, ChangeRequest
@@ -62,6 +62,11 @@ class RepoBackend:
     ) -> None:
         if not memory and path is None:
             raise ValueError("need a path unless memory=True")
+        # HM_RACEDEP=1: wrap the guard manifest's declared attributes
+        # (analysis/guards.py) in lockset descriptors BEFORE any of
+        # the hot concurrent objects below exist — daemons and bench
+        # runs get the detector without a test fixture
+        maybe_install_racedep()
         self.path = path
         self.memory = memory
         from ..storage.integrity import (
@@ -642,20 +647,24 @@ class RepoBackend:
         # stages overlap, so the wall clock is `wall_critical_path`,
         # ~max(stage) rather than sum(stages). t_fetch lands when the
         # materialization barrier runs.
-        self.last_bulk_stats = {
-            "docs": len(new_docs),
-            "fast": 0,
-            "memo": 0,
-            "fallback": 0,
-            "pipeline": 1 if pipelined else 0,
-            "t_sql": round(now() - t0, 3),
-            "t_io": 0.0,
-            "t_spec": 0.0,
-            "t_pack": 0.0,
-            "t_narrow": 0.0,
-            "t_upload": 0.0,
-            "t_dispatch": 0.0,
-        }
+        # rebinding the stats dict holds repo.stats (guard manifest,
+        # analysis/guards.py): stage threads _stat_add concurrently
+        # once the load streams, and bench/tools read the dict after
+        with self._stats_lock:
+            self.last_bulk_stats = {
+                "docs": len(new_docs),
+                "fast": 0,
+                "memo": 0,
+                "fallback": 0,
+                "pipeline": 1 if pipelined else 0,
+                "t_sql": round(now() - t0, 3),
+                "t_io": 0.0,
+                "t_spec": 0.0,
+                "t_pack": 0.0,
+                "t_narrow": 0.0,
+                "t_upload": 0.0,
+                "t_dispatch": 0.0,
+            }
 
         ready_ids: List[str] = []
         clock_rows: Dict[str, Dict[str, int]] = {}
@@ -721,17 +730,19 @@ class RepoBackend:
         if pipelined:
             # busy aliases: explicit names for consumers (bench JSON)
             # that want both views without knowing the mode
-            for k in (
-                "t_io", "t_spec", "t_pack", "t_narrow", "t_upload",
-                "t_dispatch",
-            ):
-                self.last_bulk_stats[k + "_busy"] = (
-                    self.last_bulk_stats.get(k, 0.0)
-                )
+            with self._stats_lock:
+                for k in (
+                    "t_io", "t_spec", "t_pack", "t_narrow", "t_upload",
+                    "t_dispatch",
+                ):
+                    self.last_bulk_stats[k + "_busy"] = (
+                        self.last_bulk_stats.get(k, 0.0)
+                    )
         # provisional: the barrier extends this through the fetch
-        self.last_bulk_stats["wall_critical_path"] = round(
-            now() - self._bulk_t0, 3
-        )
+        with self._stats_lock:
+            self.last_bulk_stats["wall_critical_path"] = round(
+                now() - self._bulk_t0, 3
+            )
         ready_ids.extend(already_ready)
         if ready_ids:
             self.to_frontend.push(msgs.bulk_ready_msg(ready_ids))
@@ -1211,34 +1222,41 @@ class RepoBackend:
         were packing/dispatching; this barrier joins that worker (re-
         raising any fetch failure) and assembles host-side only —
         `t_fetch` records the residual (non-overlapped) wait, while
-        `t_fetch_busy` holds the worker's busy time."""
+        `t_fetch_busy` holds the worker's busy time.
+
+        Runs under `repo.bulk` (the guard of the pending accumulators,
+        analysis/guards.py): a barrier racing a new load would
+        otherwise swap the pending lists out from under each other —
+        the load's stale-join path still covers barrier-less loads."""
         from ..ops.materialize import BulkSummaries
 
-        pending = self._pending_summaries
-        memo_pending = self._pending_memo
-        fetch_ctx = self._fetch_ctx
-        wall_t0 = self._bulk_t0
-        self._pending_summaries = []
-        self._pending_memo = []
-        self._fetch_ctx = None
-        # one barrier per load — cleared up front so neither a fetch
-        # failure below nor a later (empty) barrier call can restamp
-        # the critical path with idle wall time
-        self._bulk_t0 = None
-        t0 = time.perf_counter()
-        if fetch_ctx is not None:
-            fetch_ctx.join()  # raises PipelineError on fetch failure
-        out = BulkSummaries(
-            pending, memo_slabs=self._memo_slabs(memo_pending)
-        )
-        self._memoize_summaries(out, pending, memo_pending)
-        self.last_bulk_stats["t_fetch"] = round(
-            time.perf_counter() - t0, 3
-        )
-        if wall_t0 is not None:
-            self.last_bulk_stats["wall_critical_path"] = round(
-                time.perf_counter() - wall_t0, 3
+        with self._bulk_mutex:
+            pending = self._pending_summaries
+            memo_pending = self._pending_memo
+            fetch_ctx = self._fetch_ctx
+            wall_t0 = self._bulk_t0
+            self._pending_summaries = []
+            self._pending_memo = []
+            self._fetch_ctx = None
+            # one barrier per load — cleared up front so neither a
+            # fetch failure below nor a later (empty) barrier call can
+            # restamp the critical path with idle wall time
+            self._bulk_t0 = None
+            t0 = time.perf_counter()
+            if fetch_ctx is not None:
+                fetch_ctx.join()  # raises PipelineError on fetch failure
+            out = BulkSummaries(
+                pending, memo_slabs=self._memo_slabs(memo_pending)
             )
+            self._memoize_summaries(out, pending, memo_pending)
+        with self._stats_lock:
+            self.last_bulk_stats["t_fetch"] = round(
+                time.perf_counter() - t0, 3
+            )
+            if wall_t0 is not None:
+                self.last_bulk_stats["wall_critical_path"] = round(
+                    time.perf_counter() - wall_t0, 3
+                )
         return out
 
     @staticmethod
@@ -2001,8 +2019,9 @@ class RepoBackend:
         # fetch worker draining device buffers: settle it before the
         # feeds / slab mmap / sqlite it indirectly depends on go away,
         # and surface (as a log) any error nobody barriered to see
-        ctx = self._fetch_ctx
-        self._fetch_ctx = None
+        with self._bulk_mutex:
+            ctx = self._fetch_ctx
+            self._fetch_ctx = None
         if ctx is not None:
             try:
                 ctx.join()

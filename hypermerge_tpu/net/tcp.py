@@ -170,8 +170,8 @@ class TcpDuplex:
         self._sock.settimeout(10)
         pk = self._session.handshake_bytes
         frame = bytes([1 if offer else 0]) + pk
-        lockdep.blocking("socket_send", "handshake")
-        self._sock.sendall(_HDR.pack(len(frame)) + frame)
+        with lockdep.blocking("socket_send", "handshake"):
+            self._sock.sendall(_HDR.pack(len(frame)) + frame)
         hdr = self._read_exact(_HDR.size)
         if hdr is None:
             raise OSError("peer closed during handshake")
@@ -193,8 +193,8 @@ class TcpDuplex:
             auth = self._session.encrypt(
                 self._session.auth_frame(self._identity)
             )
-            lockdep.blocking("socket_send", "auth")
-            self._sock.sendall(_HDR.pack(len(auth)) + auth)
+            with lockdep.blocking("socket_send", "auth"):
+                self._sock.sendall(_HDR.pack(len(auth)) + auth)
             hdr = self._read_exact(_HDR.size)
             if hdr is None:
                 raise OSError("peer closed during auth")
@@ -332,8 +332,8 @@ class TcpDuplex:
                 # the single writer thread orders encryption and writes
                 if self._session is not None:
                     data = self._session.encrypt(data)
-                lockdep.blocking("socket_send", "frame")
-                self._sock.sendall(_HDR.pack(len(data)) + data)
+                with lockdep.blocking("socket_send", "frame"):
+                    self._sock.sendall(_HDR.pack(len(data)) + data)
                 _M_FRAMES_TX.add(1)
                 _M_BYTES_TX.add(_HDR.size + len(data))
                 self._last_progress = time.monotonic()

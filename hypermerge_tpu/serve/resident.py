@@ -276,8 +276,8 @@ class ResidencyCache:
             return evicted
 
     def _note_evicted(self, doc_id: str) -> None:
-        """Remember (bounded) that this id was resident once. Caller
-        holds the lock."""
+        """Remember (bounded) that this id was resident once.
+        REQUIRES serve.cache (analysis/guards.py)."""
         self._evicted[doc_id] = None
         self._evicted.move_to_end(doc_id)
         while len(self._evicted) > self.EVICTED_REMEMBERED:
@@ -324,11 +324,13 @@ class ResidencyCache:
 
     @property
     def resident_bytes(self) -> int:
+        # atomic_read_ok (analysis/guards.py): monitoring snapshot
         return self._bytes
 
     @property
     def resident_docs(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def report(self) -> Dict[str, Any]:
         """Per-doc residency for tools/ls.py (via the Telemetry

@@ -553,22 +553,22 @@ def io_open(path: str, mode: str = "rb"):
 def io_fsync(fh) -> None:
     """fsync through the harness: may raise EIO, may LIE (succeed
     without durability — visible only to the power-cut replay)."""
-    lockdep.blocking("fsync", getattr(fh, "path", "") or "")
-    a = _active
-    if a is None:
-        os.fsync(fh.fileno())
-        return
-    path = getattr(fh, "path", None)
-    lied = False
-    if a.plan is not None and path is not None:
-        fate, err = a.plan.fsync_fate(_plan_rel(path))
-        if fate == _F_ERROR:
-            raise OSError(err, os.strerror(err), path)
-        lied = fate == _F_LIE
-    if not lied:
-        os.fsync(fh.fileno())
-    if a.recorder is not None and path is not None:
-        a.recorder.on_fsync(path, lied)
+    with lockdep.blocking("fsync", getattr(fh, "path", "") or ""):
+        a = _active
+        if a is None:
+            os.fsync(fh.fileno())
+            return
+        path = getattr(fh, "path", None)
+        lied = False
+        if a.plan is not None and path is not None:
+            fate, err = a.plan.fsync_fate(_plan_rel(path))
+            if fate == _F_ERROR:
+                raise OSError(err, os.strerror(err), path)
+            lied = fate == _F_LIE
+        if not lied:
+            os.fsync(fh.fileno())
+        if a.recorder is not None and path is not None:
+            a.recorder.on_fsync(path, lied)
 
 
 def io_replace(src: str, dst: str) -> None:

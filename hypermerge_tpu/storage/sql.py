@@ -66,8 +66,7 @@ class SqlDatabase:
         with self._lock:
             self._conn.executescript(_SCHEMA)
             self._record("script", _SCHEMA, None)
-            self._conn.commit()
-            self._record_commit()
+            self._commit()
 
     def _record(self, kind: str, sql: str, params) -> None:
         if self.path == ":memory:":
@@ -76,11 +75,14 @@ class SqlDatabase:
         if rec is not None:
             rec.db_stmt(self.path, kind, sql, params)
 
-    def _record_commit(self) -> None:
-        # every commit call site pairs with this: the lockdep blocking
-        # seam for sqlite (a commit under an emission lock would stall
-        # every doc's patch pushes on disk latency)
-        lockdep.blocking("sqlite_commit", self.path)
+    def _commit(self) -> None:
+        # every commit routes through here: the lockdep blocking seam
+        # for sqlite (a commit under an emission lock would stall
+        # every doc's patch pushes on disk latency); the `with` form
+        # also times the commit into the per-held-lock-class
+        # blocking-debt counters (lock.held_blocking_ms.*)
+        with lockdep.blocking("sqlite_commit", self.path):
+            self._conn.commit()
         if self.path == ":memory:":
             return
         rec = active_recorder()
@@ -100,16 +102,14 @@ class SqlDatabase:
             finally:
                 self._defer_commit -= 1
                 if self._defer_commit == 0:
-                    self._conn.commit()
-                    self._record_commit()
+                    self._commit()
 
     def execute(self, sql: str, params=()) -> sqlite3.Cursor:
         with self._lock:
             cur = self._conn.execute(sql, params)
             self._record("exec", sql, tuple(params))
             if not self._defer_commit:
-                self._conn.commit()
-                self._record_commit()
+                self._commit()
             return cur
 
     def executemany(self, sql: str, rows) -> None:
@@ -119,8 +119,7 @@ class SqlDatabase:
             self._conn.executemany(sql, rows)
             self._record("many", sql, rows)
             if not self._defer_commit:
-                self._conn.commit()
-                self._record_commit()
+                self._commit()
 
     def query(self, sql: str, params=()) -> list:
         with self._lock:

@@ -243,9 +243,10 @@ class CursorStore:
         self._del_gen: Dict[str, int] = {}
 
     def _repo(self, repo_id: str) -> Dict[str, Dict[str, int]]:
-        """The repo's mirror dicts (created empty on demand). Caller
-        holds self._lock. Hydration from SQLite happens ONLY in
-        _ensure_hydrated — never here, never under the mirror lock."""
+        """The repo's mirror dicts (created empty on demand).
+        REQUIRES store.cursors (analysis/guards.py). Hydration from
+        SQLite happens ONLY in _ensure_hydrated — never here, never
+        under the mirror lock."""
         mem = self._mem.get(repo_id)
         if mem is None:
             mem = self._mem[repo_id] = {}
@@ -289,8 +290,8 @@ class CursorStore:
     def _absorb(
         self, repo_id: str, doc_id: str, actor: str, seq: int
     ) -> None:
-        """Max-wins merge into the mirror (the upsert's twin). Caller
-        holds self._lock."""
+        """Max-wins merge into the mirror (the upsert's twin).
+        REQUIRES store.cursors (analysis/guards.py)."""
         cur = self._repo(repo_id).setdefault(doc_id, {})
         if actor not in cur or seq > cur[actor]:
             cur[actor] = seq

@@ -87,24 +87,24 @@ class Debouncer:
         False if the timeout expired with work still in flight, so
         callers whose next step assumes durability (destroy deleting
         rows a late flush would resurrect) can act on the failure."""
-        lockdep.blocking("flush_wait", self._name)
-        deadline = time.monotonic() + timeout
-        with self._cv:
-            while self._keys or self._flushing:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    return False
-                self._cv.wait(remaining)
+        with lockdep.blocking("flush_wait", self._name):
+            deadline = time.monotonic() + timeout
+            with self._cv:
+                while self._keys or self._flushing:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    self._cv.wait(remaining)
         return True
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop accepting marks and drain: pending keys are flushed
         before the flusher thread exits."""
-        lockdep.blocking("thread_join", self._name)
-        with self._cv:
-            self._closed = True
-            self._cv.notify_all()
-        self._thread.join(timeout)
+        with lockdep.blocking("thread_join", self._name):
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            self._thread.join(timeout)
 
     def _loop(self) -> None:
         last_flush = 0.0

@@ -73,15 +73,17 @@ class Queue(Generic[T]):
         """Block until the first item is available and return it (does not
         consume — mirrors the promise-shaped `first()` of the reference,
         src/Queue.ts:16-20)."""
-        lockdep.blocking("queue_first", self.name)
-        ev = threading.Event()
-        with self._lock:
-            if self._has_first:
-                return self._first_value  # type: ignore[return-value]
-            self._first_waiters.append(ev)
-        if not ev.wait(timeout):
-            raise TimeoutError(f"queue {self.name!r} first() timed out")
-        return self._first_value  # type: ignore[return-value]
+        with lockdep.blocking("queue_first", self.name):
+            ev = threading.Event()
+            with self._lock:
+                if self._has_first:
+                    return self._first_value  # type: ignore[return-value]
+                self._first_waiters.append(ev)
+            if not ev.wait(timeout):
+                raise TimeoutError(
+                    f"queue {self.name!r} first() timed out"
+                )
+            return self._first_value  # type: ignore[return-value]
 
     def drain(self) -> List[T]:
         with self._lock:

@@ -16,6 +16,14 @@ instead of span name; --threads adds a per-thread busy breakdown. The
 serving tier's spans show up as `serve.read` (per-request latency,
 admission to completion) and `serve.batch` (one coalesced kernel
 flush) — their count ratio IS the read-batching factor.
+
+Instrumented runs (HM_LOCKDEP=1 / HM_RACEDEP=1) add two instants in
+the `lock` category: `lock.held_blocking` (a blocking primitive ran
+while a no-block emission lock was held — each one is a stall of every
+doc's patch pushes) and `lock.racedep_violation` (the lockset detector
+observed a guard-manifest violation). Their counts surface in the
+instants total; grep the trace JSON for the names to locate them on
+the timeline.
 """
 
 import argparse

@@ -1,0 +1,50 @@
+"""Shared racedep-on-for-this-module fixture (test_live,
+test_serve_races) — the lockset sibling of tests/lockdep_fixture.py.
+
+HM_RACEDEP=1 wraps every non-`unguarded` attribute of the guard
+manifest (hypermerge_tpu/analysis/guards.py) in an Eraser-style
+lockset descriptor: each access intersects the per-(object, attribute)
+candidate lockset with the accessing thread's held locks, so a shared
+field that no lock consistently guards is REPORTED without the race
+ever needing to fire. Running the live twin + serve race suites fully
+instrumented turns their churn into a guard-map verifier; the module
+teardown asserts a clean lockset report.
+
+`blocking` violations are excluded for the same reason as the lockdep
+fixture: the live path's feed-append/clock-commit inside the engine
+lock is the KNOWN write-plane debt (now measured as
+`lock.held_blocking_ms.live_engine`; the per-doc emission split is
+gated on it reading zero).
+"""
+
+import os
+
+import pytest
+
+from hypermerge_tpu.analysis import lockdep
+
+
+def racedep_suite():
+    """Module-scoped autouse fixture factory: instrument the guard
+    manifest's attributes for every object created while this module's
+    tests run, and assert a clean lockset report at teardown."""
+
+    @pytest.fixture(autouse=True, scope="module")
+    def _racedep_suite():
+        was_env = os.environ.get("HM_RACEDEP")
+        os.environ["HM_RACEDEP"] = "1"
+        lockdep.install_racedep()  # implies lockdep enable
+        yield
+        if was_env is None:
+            os.environ.pop("HM_RACEDEP", None)
+        else:
+            os.environ["HM_RACEDEP"] = was_env
+        try:
+            lockdep.assert_clean(
+                allow_kinds=("blocking",),
+                msg="the suite's churn surfaced lockset findings:",
+            )
+        finally:
+            lockdep.uninstall_racedep()
+
+    return _racedep_suite

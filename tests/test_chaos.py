@@ -37,8 +37,12 @@ from hypermerge_tpu.repo import Repo
 
 from helpers import wait_until
 from lockdep_fixture import lockdep_suite
+from racedep_fixture import racedep_suite
 
 _lockdep_suite = lockdep_suite()
+# churn/kill/heal under the lockset detector: the NetworkPeer /
+# SessionSupervisor guard rows verified live (tests/racedep_fixture.py)
+_racedep_suite = racedep_suite()
 
 
 @pytest.fixture

@@ -23,11 +23,16 @@ import pytest
 
 from helpers import Site, plainify, random_mutation, sync, wait_until
 from lockdep_fixture import lockdep_suite
+from racedep_fixture import racedep_suite
 from hypermerge_tpu.models import Text
 from hypermerge_tpu.repo import Repo
 from hypermerge_tpu.utils.ids import validate_doc_url
 
 _lockdep_suite = lockdep_suite()
+# the live twin suite doubles as the guard-map verifier: every
+# declared shared field races through here fully instrumented
+# (tests/racedep_fixture.py), asserted clean at teardown
+_racedep_suite = racedep_suite()
 
 
 @pytest.fixture

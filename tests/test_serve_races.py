@@ -16,8 +16,12 @@ import pytest
 from hypermerge_tpu.models import Text
 from hypermerge_tpu.repo import Repo
 from lockdep_fixture import lockdep_suite
+from racedep_fixture import racedep_suite
 
 _lockdep = lockdep_suite()
+# eviction churn + invalidation races under the lockset detector
+# (tests/racedep_fixture.py): the serve-tier guard rows verified live
+_racedep = racedep_suite()
 
 
 @pytest.fixture

@@ -24,7 +24,7 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-from hypermerge_tpu.analysis import envvars, linter  # noqa: E402
+from hypermerge_tpu.analysis import envvars, guards, linter  # noqa: E402
 
 
 def main() -> int:
@@ -46,10 +46,18 @@ def main() -> int:
         "--env-table", action="store_true",
         help="print the README HM_* env-var markdown table and exit",
     )
+    ap.add_argument(
+        "--guards-table", action="store_true",
+        help="print the README guard-map markdown table "
+             "(analysis/guards.py) and exit",
+    )
     args = ap.parse_args()
 
     if args.env_table:
         print(envvars.markdown_table())
+        return 0
+    if args.guards_table:
+        print(guards.markdown_table())
         return 0
 
     root = linter.repo_root()

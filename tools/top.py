@@ -9,6 +9,14 @@ read-serving tier's serve.* block: reads/s, batched dispatches/s,
 residency hit/install/eviction rates, fallbacks/s (the [serve] group;
 `python tools/serve.py --ipc <sock>` exposes the same socket).
 
+Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
+the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
+per-lock-class blocking-debt series whose ``live_engine`` row is the
+write-plane split gate (ms of blocking calls under that lock, per
+second) — and ``lock.racedep_violations``, the lockset race detector's
+finding counter (any nonzero rate means a guard-manifest violation was
+just observed; pull the daemon's lockdep report for the stacks).
+
     # against a daemon (python -m hypermerge_tpu.net.ipc repo sock --persist)
     python tools/top.py --sock /tmp/backend.sock [--interval 1.0]
 
