@@ -47,6 +47,7 @@ BENCH_HOST_DOCS (8), BENCH_DIR (corpus location, default a fresh tmpdir).
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -278,6 +279,137 @@ def _config_lockdebt():
             os.environ.pop("HM_FSYNC", None)
         else:
             os.environ["HM_FSYNC"] = env_fsync
+
+
+_WRITER_CHILD = r"""
+import json, sys, threading, time
+
+sock, n_edits = sys.argv[1], int(sys.argv[2])
+
+from hypermerge_tpu.net.ipc import connect_frontend
+
+front, close = connect_frontend(sock)
+url = front.create({"n": 0})
+h = front.open(url)
+h.value(timeout=60)
+
+latest = [0]
+done = threading.Event()
+goal = [None]
+
+def on_state(_state, index):
+    if index > latest[0]:
+        latest[0] = index
+    if goal[0] is not None and latest[0] >= goal[0]:
+        done.set()
+
+h.subscribe(on_state)
+print("ready", flush=True)
+sys.stdin.readline()  # the coordinator's "go"
+
+# each change round-trips: the frontend keeps ONE request in flight
+# and the backend's LocalPatch echo (with the bumped history index)
+# releases the next — so `n_edits` acked edits means the history
+# index advances by n_edits over the ready base
+base = latest[0]
+goal[0] = base + n_edits
+t0 = time.perf_counter()
+for i in range(n_edits):
+    front.change(url, lambda d, _i=i: d.__setitem__("n", _i))
+ok = done.wait(timeout=120)
+dt = time.perf_counter() - t0
+print(json.dumps({"edits": n_edits, "secs": dt, "acked": ok}), flush=True)
+close()
+"""
+
+
+def _config_writers(n_edits=200, counts=(1, 8)):
+    """The many-writer write plane, measured end to end: N frontend
+    PROCESSES, each editing its own doc over IPC against ONE hub-mode
+    daemon (net/ipc.py --hub) on a disk-backed repo at HM_FSYNC=1 with
+    DURABLE acks (HM_ACK_DURABLE=1: every LocalPatch echo waits for
+    the WAL group commit covering its append, HM_WAL_MS=3 gather).
+    Every writer's edit loop is ack-paced (one request in flight; the
+    durable echo releases the next), so a single writer pays the full
+    {emission + commit window + fsync} per edit, and aggregate edits/s
+    scales with writer count only if (a) disjoint docs' {patch -> feed
+    append -> push} pipelines really run concurrently (the per-doc
+    emission domains, backend/emission.py — the old engine-lock plane
+    serialized them) and (b) concurrent committers share the leader's
+    ONE journal fsync per window (storage/wal.py group commit — the
+    old group flush was O(dirty feeds)). Returns per-count aggregate
+    durable edits/s and the 1 -> max scaling factor (the ROADMAP
+    gate: >= 3x at 8)."""
+    import tempfile as _tempfile
+
+    results = {}
+    per_writer = {}
+    for n_writers in counts:
+        tmp = _tempfile.mkdtemp(prefix="hm-writers-")
+        sock = os.path.join(tmp, "daemon.sock")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HM_FSYNC"] = "1"
+        env["HM_ACK_DURABLE"] = "1"
+        env["HM_WAL_MS"] = "3"
+        env["PYTHONPATH"] = str(Path(__file__).parent)
+        daemon = subprocess.Popen(
+            [
+                sys.executable, "-m", "hypermerge_tpu.net.ipc",
+                os.path.join(tmp, "repo"), sock, "--hub",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        writers = []
+        try:
+            line = daemon.stdout.readline()
+            if "ready" not in line:
+                raise RuntimeError(f"daemon failed to start: {line!r}")
+            writers = [
+                subprocess.Popen(
+                    [sys.executable, "-c", _WRITER_CHILD, sock,
+                     str(n_edits)],
+                    env=env,
+                    stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+                for _ in range(n_writers)
+            ]
+            for w in writers:
+                if w.stdout.readline().strip() != "ready":
+                    raise RuntimeError(
+                        f"writer failed: {w.stderr.read()[-500:]}"
+                    )
+            for w in writers:  # all docs open: release the herd
+                w.stdin.write("go\n")
+                w.stdin.flush()
+            outs = [json.loads(w.stdout.readline()) for w in writers]
+            if not all(o["acked"] for o in outs):
+                raise RuntimeError("writer timed out waiting for acks")
+            wall = max(o["secs"] for o in outs)
+            results[n_writers] = round(n_writers * n_edits / wall, 1)
+            per_writer[n_writers] = [round(o["secs"], 3) for o in outs]
+        finally:
+            for w in writers:
+                w.kill()
+            daemon.terminate()
+            try:
+                daemon.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                daemon.kill()
+            shutil.rmtree(tmp, ignore_errors=True)
+    lo, hi = min(counts), max(counts)
+    return {
+        "edits_per_s": results,
+        "scaling": round(results[hi] / max(results[lo], 1e-9), 2),
+        "writer_secs": per_writer,
+        "n_edits": n_edits,
+    }
 
 
 def _config1_change_latency():
@@ -1310,6 +1442,16 @@ def main() -> None:
             f"ms at HM_FSYNC=2; per class {cfgld}",
             file=sys.stderr,
         )
+    cfgwr = _soft("config_writers", _config_writers)
+    if cfgwr is not None:
+        eps = cfgwr["edits_per_s"]
+        print(
+            f"# config_writers many-writer plane (IPC procs, disjoint "
+            f"docs, HM_FSYNC=1): "
+            + ", ".join(f"{k}w {v:,.0f} edits/s" for k, v in eps.items())
+            + f" -> {cfgwr['scaling']:.1f}x scaling",
+            file=sys.stderr,
+        )
     cfg3 = _soft("config3", _config3_multiactor)
     if cfg3 is not None:
         print(
@@ -1422,6 +1564,15 @@ def main() -> None:
                     # instrumented durable burst; the `live_engine`
                     # entry gates the ROADMAP write-plane split
                     "lock_held_blocking_ms": cfgld,
+                    # many-writer plane: N IPC writer processes on
+                    # disjoint docs vs ONE hub daemon at HM_FSYNC=1
+                    "config_writers_edits_per_s": (
+                        cfgwr["edits_per_s"] if cfgwr is not None
+                        else None
+                    ),
+                    "config_writers_scaling": (
+                        cfgwr["scaling"] if cfgwr is not None else None
+                    ),
                     "config3_multiactor_ops_per_s": (
                         round(cfg3[1]) if cfg3 is not None else None
                     ),

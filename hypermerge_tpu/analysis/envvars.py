@@ -87,6 +87,21 @@ REGISTRY: Tuple[EnvVar, ...] = (
     EnvVar("HM_FSYNC", "0", "Durability tier: 0 none, 1 group-fsync "
            "window, 2 fsync per append."),
     EnvVar("HM_FSYNC_MS", "25", "Group-fsync window for HM_FSYNC=1."),
+    EnvVar("HM_WAL", "1", "Shared per-repo write-ahead journal "
+           "(storage/wal.py): a durable commit window is ONE "
+           "sequential append + ONE fsync regardless of dirty feed "
+           "count (0 = legacy per-feed fsyncs)."),
+    EnvVar("HM_WAL_MS", "0", "Group-commit gather window of the WAL "
+           "leader fsync (tier-2 acks and HM_ACK_DURABLE tier-1 acks; "
+           "0 = sync immediately; concurrent committers still share "
+           "one fsync)."),
+    EnvVar("HM_ACK_DURABLE", "0", "=1 makes a local edit's ack "
+           "DURABLE at HM_FSYNC=1: the LocalPatch echo waits for the "
+           "WAL group commit covering its append (N writers share "
+           "one fsync per HM_WAL_MS window)."),
+    EnvVar("HM_WAL_MAX_BYTES", "67108864", "Journal size that "
+           "triggers a checkpoint (per-feed logs fsynced off the ack "
+           "path, journal reset to its dirty-name ledger)."),
     EnvVar("HM_RECOVER", "1", "Whole-repo recovery-on-open after a "
            "crash marker (0 = skip; tools/scrub.py --dry-run sets it)."),
     EnvVar("HM_SIGN_INTERVAL", "1024", "Appends between persisted "

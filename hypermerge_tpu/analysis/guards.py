@@ -68,14 +68,56 @@ GUARDS: Tuple[GuardedClass, ...] = (
     GuardedClass(
         "LiveApplyEngine", "hypermerge_tpu.backend.live", "live.engine",
         guarded=(
-            "_docs", "_refused", "_adopting", "_demoted_ids",
+            "_refused", "_adopting", "_demoted_ids",
             "_use_clock",
         ),
+        atomic_read_ok=("_docs",),
         init_only=("_back", "_m", "_ticker"),
-        doc="The engine's doc table, refusal/adoption/demotion sets "
-            "and the LRU use-clock all mutate under the ONE emission "
-            "lock; adoption BUILDS run lock-free but install under it "
-            "with a recheck (the PR-4 idiom).",
+        doc="Tick/dirty-set coordination only since the write-plane "
+            "split: the doc table and refusal/adoption/demotion sets "
+            "mutate under the engine lock, but `_docs` LOOKUPS are "
+            "GIL-atomic dict.get snapshots — the tick and the "
+            "emission paths resolve a doc with NO engine lock held "
+            "and recheck identity under the doc's emission domain. "
+            "Adoption BUILDS run lock-free and install under the "
+            "engine lock with a recheck (the PR-4 idiom). Per-doc "
+            "live state lives on `_LiveDoc` under `doc.emit`.",
+    ),
+    GuardedClass(
+        "_LiveDoc", "hypermerge_tpu.backend.live", "doc.emit",
+        guarded=(
+            "state", "clock", "max_op", "history_len", "pending",
+            "queued", "undecoded",
+        ),
+        atomic_read_ok=("tick_rows",),
+        init_only=("doc", "cols"),
+        doc="One doc's live write-plane state — decoded state, "
+            "admission clock/pending set, queued tick changes, and "
+            "the appended-but-undecoded marker — all under ITS OWN "
+            "emission domain (backend/emission.py), never the engine "
+            "lock: this is the relocated half of the old engine-lock "
+            "guard rows. `cols` is rebound only at construction; its "
+            "in-place appends happen under the domain. `tick_rows` — "
+            "the phase-3 install-and-recheck token — is written under "
+            "the domain (_tick_doc_locked); the tick loop's bucket "
+            "grouping reads it as a GIL-atomic int snapshot with no "
+            "domain held, and phase 3 rechecks it under the domain "
+            "before installing.",
+    ),
+    GuardedClass(
+        "_LiveDoc(engine)", "hypermerge_tpu.backend.live",
+        "live.engine",
+        guarded=("last_use", "demotable_at"),
+        doc="The LRU bookkeeping the ENGINE owns about a live doc "
+            "(use-clock stamp, demotability memo): read and written "
+            "by the coordination passes under the engine lock.",
+    ),
+    GuardedClass(
+        "EmissionDomain", "hypermerge_tpu.backend.emission", "doc.emit",
+        init_only=("doc_id",),
+        doc="The per-doc emission domain handle itself: one "
+            "re-entrant doc.emit lock plus its identity. All real "
+            "state it orders lives on the doc/_LiveDoc.",
     ),
     GuardedClass(
         "DocBackend", "hypermerge_tpu.backend.doc_backend", "doc",
@@ -86,7 +128,7 @@ GUARDS: Tuple[GuardedClass, ...] = (
         ),
         atomic_read_ok=("opset", "_announced", "actor_id"),
         init_only=("id", "_notify", "_live", "ready", "local_q",
-                   "remote_q"),
+                   "remote_q", "emission"),
         doc="Per-doc CRDT/lazy state under the doc lock. `opset` and "
             "`_announced` transition once (None->OpSet, "
             "False->True) and are snapshot-read on the hot dispatch "
@@ -181,6 +223,28 @@ GUARDS: Tuple[GuardedClass, ...] = (
             "(NetworkPeer.try_send) instead of check-then-use.",
     ),
     GuardedClass(
+        "_FrontendHub", "hypermerge_tpu.net.ipc", "net.ipc.hub",
+        guarded=("_conns", "_interest", "_next_key"),
+        init_only=("_back",),
+        doc="The multi-frontend daemon's connection + doc-interest "
+            "tables (accept/reader threads register and retire "
+            "entries, the to_frontend router snapshots its targets) "
+            "mutate under net.ipc.hub; socket sends run OUTSIDE it "
+            "so a slow frontend cannot stall accepts or routing.",
+    ),
+    GuardedClass(
+        "FileFeedStorage", "hypermerge_tpu.storage.feed",
+        "store.feed_io",
+        guarded=("_wfh", "_len_fh", "_fh_gen"),
+        doc="The cached write handles (block log + .len sidecar) and "
+            "the fault-harness generation they were opened under: "
+            "shared between the appender (under its doc's emission "
+            "domain + feed lock) and the WAL checkpoint thread's "
+            "storage.sync() — every use, fsync, and drop serializes "
+            "under store.feed_io, or interleaved seek/write could "
+            "tear the sidecar and a drop could close an fd mid-fsync.",
+    ),
+    GuardedClass(
         "CursorStore", "hypermerge_tpu.storage.stores", "store.cursors",
         guarded=("_mem", "_by_actor", "_del_gen"),
         atomic_read_ok=("_hydrated",),
@@ -194,10 +258,34 @@ GUARDS: Tuple[GuardedClass, ...] = (
         "DurabilityManager", "hypermerge_tpu.storage.durability",
         "store.durability",
         guarded=("_dirty", "_closed"),
-        atomic_read_ok=("_flusher",),
+        atomic_read_ok=("_flusher", "wal"),
+        unguarded=("_wal_suspended", "journalless_write_cb"),
         doc="The tier-1 dirty set and shutdown latch mutate under "
             "store.durability; flush_now snapshots the flusher handle "
-            "lock-free (it is installed once and cleared at close).",
+            "lock-free (it is installed once and cleared at close). "
+            "`wal` is attached once at repo open (before traffic) and "
+            "snapshot-read on every journal_append. `_wal_suspended` "
+            "is toggled only inside the single-threaded recovery "
+            "replay window (scrub runs before any doc opens). "
+            "`journalless_write_cb` is a fire-once latch set at repo "
+            "open; a racing double-clear at worst double-fires the "
+            "idempotent stamp invalidation.",
+    ),
+    GuardedClass(
+        "WriteAheadLog", "hypermerge_tpu.storage.wal", "store.wal",
+        guarded=(
+            "_fh", "_end", "_file_bytes", "_synced", "_syncing",
+            "_dirty_names", "_ckpt_pending", "_ckpt_running",
+            "_closed",
+        ),
+        init_only=("path", "session", "tier", "_max_bytes",
+                   "_window_s"),
+        doc="The shared journal: file handle (rebound at checkpoint "
+            "rotation), append end offset, the group-commit "
+            "synced/syncing handshake, the session dirty-name ledger "
+            "and the checkpoint-pending storage set all mutate under "
+            "store.wal. The commit fsync snapshots the handle under "
+            "the lock and syncs OUTSIDE it.",
     ),
 )
 
@@ -207,11 +295,17 @@ GUARDS: Tuple[GuardedClass, ...] = (
 # detector needs no such hint: it sees the actual held stack.)
 REQUIRES: Dict[Tuple[str, str], str] = {
     ("LiveApplyEngine", "_bump_use"): "live.engine",
-    ("LiveApplyEngine", "_flush_ids"): "live.engine",
-    ("LiveApplyEngine", "_enforce_budget_locked"): "live.engine",
-    ("LiveApplyEngine", "_demote_pass"): "live.engine",
+    ("LiveApplyEngine", "_tick_doc_locked"): "doc.emit",
+    ("LiveApplyEngine", "_catch_up_locked"): "doc.emit",
+    ("LiveApplyEngine", "_demote_candidates_locked"): "live.engine",
     ("LiveApplyEngine", "_demote_locked"): "live.engine",
-    ("LiveApplyEngine", "_evict_to_host"): "live.engine",
+    ("WriteAheadLog", "_append_dirty_locked"): "store.wal",
+    ("WriteAheadLog", "_write_locked"): "store.wal",
+    ("FileFeedStorage", "_append_io_locked"): "store.feed_io",
+    ("FileFeedStorage", "_check_gen"): "store.feed_io",
+    ("FileFeedStorage", "_write_handle"): "store.feed_io",
+    ("FileFeedStorage", "_drop_write_handles"): "store.feed_io",
+    ("FileFeedStorage", "_write_len"): "store.feed_io",
     ("DocBackend", "_minimum_satisfied"): "doc",
     ("RepoBackend", "_load_documents_bulk_locked"): "repo.bulk",
     ("RepoBackend", "_load_slabs_serial"): "repo.bulk",

@@ -24,15 +24,24 @@ participate in cycle detection, but no pairwise order is declared for
 them (the net layer's fine-grained locks are ordered empirically by
 the cycle detector rather than by decree). `leaf=True` means no other
 tracked lock may be acquired while holding it. `no_block=True` marks
-the emission locks: no fsync / socket send / sqlite commit / thread
-join may run while they are held (the live engine lock serializes
-every {compute patch -> push} pair — see backend/live.py — so a
-blocking call under it stalls every doc's emissions at once).
+the GLOBAL coordination locks: no fsync / socket send / sqlite commit
+/ thread join may run while they are held. Since the write-plane
+split (backend/emission.py) the only no-block class is `live.engine`
+— blocking under it would stall EVERY doc's tick coordination, and
+`lock.held_blocking_ms.live_engine` must read zero at every HM_FSYNC
+tier (the bench `config_lockdebt` gate). The per-doc emission domain
+`doc.emit` is explicitly allowed to block: a durable ack (WAL group
+commit, feed append) under it stalls exactly ONE doc.
 
 The established core order (outermost first):
 
-    repo.bulk -> live.engine -> doc.emit -> doc -> repo -> actor
+    repo.bulk -> doc.emit -> live.engine -> doc -> repo -> actor
               -> store.* -> util.* -> telemetry / util.debug
+
+(`doc.emit` OUTRANKS the engine lock: an emission path holds its
+doc's domain first and dips into the engine for table bookkeeping;
+the tick looks docs up with a GIL-atomic snapshot and takes each
+doc's domain with NO engine lock held — never two domains at once.)
 
 with `store.integrity`, `telemetry.shard` and `util.debug` as leaves.
 Leaf semantics are scoped to the RANKED world: a leaf may still touch
@@ -69,25 +78,33 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
     LockClass(
         "repo.bulk", 5,
         "RepoBackend._bulk_mutex — serializes whole bulk loads; held "
-        "across ready-notifies that may take the engine lock, so it "
-        "is the outermost lock in the process.",
+        "across ready-notifies that may take a doc's emission domain, "
+        "so it is the outermost lock in the process.",
+    ),
+    LockClass(
+        "doc.emit", 8,
+        "DocBackend.emission (backend/emission.py EmissionDomain) — "
+        "ONE re-entrant lock per doc, THE emission ordering domain: "
+        "every {compute patch -> feed append -> push} pair of that "
+        "doc (live ticks, apply_local echoes, Ready snapshots, the "
+        "HM_LIVE=0 host path) holds exactly its own doc's domain. "
+        "Cross-doc nesting is FORBIDDEN (a same-class edge is a "
+        "lockdep order violation); a thread mid-emission that "
+        "re-enters the repo for ANOTHER doc defers through "
+        "emission.defer(). MAY block: a durable ack (WAL group "
+        "commit, tier-2 fsync) under it stalls exactly one doc — "
+        "that is the write-plane split.",
     ),
     LockClass(
         "live.engine", 10,
-        "LiveApplyEngine._lock — THE emission lock under HM_LIVE=1: "
-        "every {compute patch -> push} pair (ticks, apply_local "
-        "echoes, send_ready_atomic, the host path via "
-        "DocBackend._emission_lock) runs under this one re-entrant "
-        "lock. Nothing below it in this table may be held when it is "
-        "acquired.",
-        no_block=True,
-    ),
-    LockClass(
-        "doc.emit", 12,
-        "DocBackend._emit_lock — the HM_LIVE=0 twin of live.engine: "
-        "serializes one doc's host-path emission pairs. Never held "
-        "together with live.engine (it is only used when the engine "
-        "is off).",
+        "LiveApplyEngine._lock — tick/dirty-set COORDINATION only "
+        "since the write-plane split: the doc table, "
+        "refusal/adoption/demotion bookkeeping, and the LRU "
+        "use-clock. Never held across a feed append, fsync, or "
+        "frontend push (emissions run under the per-doc doc.emit "
+        "domain, which OUTRANKS this lock); "
+        "lock.held_blocking_ms.live_engine reading zero at every "
+        "HM_FSYNC tier is the machine-checked invariant.",
         no_block=True,
     ),
     LockClass(
@@ -129,6 +146,15 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "append + merkle sign; listeners fire after release.",
     ),
     LockClass(
+        "store.feed_io", 52,
+        "FileFeedStorage._io — the cached write handles (log + .len "
+        "sidecar) and every operation that uses or drops them: the "
+        "appender (under store.feed) and the WAL checkpoint thread's "
+        "storage.sync() share the SAME fds, so seek/write/fsync/close "
+        "must serialize. Acquired under store.feed, holds across "
+        "store.wal (the journal append rides inside a feed append).",
+    ),
+    LockClass(
         "store.colcache", 54,
         "FeedColumnCache._lock — per-feed columnar sidecar.",
     ),
@@ -156,6 +182,15 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "store.durability", 66,
         "DurabilityManager._lock — the tier-1 dirty set. sync_now "
         "drains OUTSIDE it; mark_dirty is called under feed locks.",
+    ),
+    LockClass(
+        "store.wal", 67,
+        "WriteAheadLog._lock (storage/wal.py) — the shared per-repo "
+        "journal: record appends and the group-commit handshake "
+        "serialize under it (acquired under store.feed during a feed "
+        "append, hence above it). The commit fsync itself runs "
+        "OUTSIDE it — appenders keep writing while the leader "
+        "syncs.",
     ),
     LockClass(
         "store.integrity", 70,
@@ -218,6 +253,18 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "live.gc", None,
         "backend.live._gc_pause_lock — GC pause refcount across "
         "adoption builds.",
+    ),
+    LockClass(
+        "doc.emit.defer", None,
+        "backend.emission deferred-emission worker — the cross-doc "
+        "re-entry escape hatch: a thread holding doc A's emission "
+        "domain that re-enters the repo for doc B parks the work "
+        "here instead of nesting domains.",
+    ),
+    LockClass(
+        "net.ipc.hub", None,
+        "net.ipc._FrontendHub._lock — the multi-frontend daemon's "
+        "connection/interest table (accept threads vs route).",
     ),
     LockClass(
         "pipeline.err", None,
@@ -297,12 +344,17 @@ NO_BLOCK: FrozenSet[str] = frozenset(
 # ((holder_class, acquired_class), "why this nesting cannot deadlock").)
 ALLOWED_EDGES: Dict[Tuple[str, str], str] = {}
 
-# Methods that (transitively) acquire live.engine — the linter flags a
-# call to any of these from inside a `with` holding a ranked lock whose
-# rank is ABOVE the engine's (repo/doc/actor/store): that is exactly
-# the repo->engine inversion the open()/Ready deadlock was made of.
+# Methods that (transitively) acquire doc.emit / live.engine — the
+# linter flags a call to any of these from inside a `with` holding a
+# ranked lock whose rank is ABOVE the engine's (repo/doc/actor/store):
+# that is exactly the repo->engine inversion the open()/Ready deadlock
+# was made of, and since the write-plane split the same rule keeps a
+# store/doc lock from being held into an emission domain acquisition.
+# (`snapshot_patch` also enters the engine but shares its name with
+# OpSet.snapshot_patch — a lexical linter cannot tell them apart, so
+# the runtime lockdep detector owns that entrypoint.)
 ENGINE_ENTRYPOINTS: FrozenSet[str] = frozenset(
-    {"send_ready_atomic", "apply_local", "submit_remote", "demote_idle"}
+    {"apply_local", "submit_remote", "demote_idle"}
 )
 
 # Attribute/function call names the no-blocking-under-lock rule treats

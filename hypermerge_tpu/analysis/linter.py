@@ -6,7 +6,7 @@ bench.py, __graft_entry__.py), driven by the declared rule data in
 `analysis/hierarchy.py` and `analysis/envvars.py`:
 
 - **lock-order** — nested `with` acquisitions must follow the declared
-  rank order (engine -> doc.emit -> repo -> doc -> actor -> store.*;
+  rank order (doc.emit -> engine -> doc -> repo -> actor -> store.*;
   leaves nest nothing), and no ENGINE_ENTRYPOINTS call may run under a
   lock ranked below the engine (the repo->engine inversion that made
   the open()/Ready deadlock).
@@ -172,8 +172,7 @@ class _LockTable:
       - `self.<attr>`     -> exact (module class, attr) binding
       - `<name>.<attr>`   -> by attr, when the attr is unique tree-wide
       - `<name>`          -> module-level binding
-      - `self._emission_lock()` -> doc.emit (the host-twin emission)
-      - `<x>.emission_lock`     -> live.engine
+      - `<x>.emission`    -> doc.emit (the per-doc EmissionDomain)
     """
 
     def __init__(self) -> None:
@@ -228,13 +227,10 @@ class _LockTable:
         self, expr: ast.AST, rel: str, cls_name: Optional[str]
     ) -> Optional[str]:
         if isinstance(expr, ast.Call):
-            fn = expr.func
-            if isinstance(fn, ast.Attribute) and fn.attr == "_emission_lock":
-                return "doc.emit"
             return None
         if isinstance(expr, ast.Attribute):
-            if expr.attr == "emission_lock":
-                return "live.engine"
+            if expr.attr == "emission":
+                return "doc.emit"
             if (
                 isinstance(expr.value, ast.Name)
                 and expr.value.id == "self"

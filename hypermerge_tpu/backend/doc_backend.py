@@ -19,6 +19,8 @@ from ..crdt.change import Change, ChangeRequest
 from ..crdt.opset import OpSet
 from ..utils.debug import bench, log
 from ..utils.queue import Queue
+from . import emission
+from .emission import EmissionDomain
 
 
 class DocBackend:
@@ -36,19 +38,17 @@ class DocBackend:
         # actor_id) — is manifest data now: analysis/guards.py, checked
         # statically (guarded-attr) and at runtime (HM_RACEDEP=1)
         self._lock = make_rlock("doc")
-        # `doc.emit` in the declared lock hierarchy
-        # (analysis/hierarchy.py): serializes {compute patch -> push}
-        # emission pairs on the host path, so a Ready snapshot can
-        # never be pushed with a patch for a NEWER state already ahead
-        # of it in the frontend queue (a pending frontend drops
-        # pre-Ready patches). Only used when the live engine is OFF
-        # (HM_LIVE=0): with the engine on, `live.engine` is the single
-        # emission lock for every path (_emission_lock) — a second
-        # per-doc lock would invert against it when a frontend callback
-        # dispatched under one re-enters the repo and needs the other.
-        # Re-entrant for in-process frontends whose on_patch
-        # synchronously sends the next change.
-        self._emit_lock = make_rlock("doc.emit")
+        # THE doc's emission ordering domain (`doc.emit`,
+        # backend/emission.py): every {compute patch -> feed append ->
+        # push} pair of THIS doc — live ticks, local echoes, Ready
+        # snapshots, the HM_LIVE=0 host path — holds it, and nothing
+        # else's. A Ready snapshot can never be overtaken by a patch
+        # for a NEWER state of this doc (a pending frontend drops
+        # pre-Ready patches), while DISJOINT docs emit (and commit
+        # durably) in parallel. Re-entrant for in-process frontends
+        # whose on_patch synchronously sends the next change to the
+        # SAME doc; cross-doc re-entry defers (emission.defer).
+        self.emission = EmissionDomain(doc_id)
         self.opset: Optional[OpSet] = opset
         # live apply engine (backend/live.py): lazy docs' incremental
         # changes batch through per-tick kernel dispatches instead of
@@ -201,9 +201,21 @@ class DocBackend:
             )
 
     def apply_remote_changes(self, changes: List[Change]) -> None:
+        # cross-doc re-entry guard: a frontend callback running under
+        # ANOTHER doc's emission domain must not drag that domain into
+        # this doc's handler (no two domains on one thread — the
+        # write-plane invariant); the push replays on the deferred-
+        # emission worker instead
+        if emission.entered_other(self.id):
+            items = list(changes)
+            emission.defer(lambda: self.remote_q.push(items))
+            return
         self.remote_q.push(list(changes))
 
     def apply_local_request(self, req: ChangeRequest) -> None:
+        if emission.entered_other(self.id):
+            emission.defer(lambda: self.local_q.push(req))
+            return
         self.local_q.push(req)
 
     def update_minimum_clock(self, clock: clockmod.Clock) -> None:
@@ -272,9 +284,9 @@ class DocBackend:
         with self._lock:
             adopted = self._live_adopted
         if adopted and live is not None:
-            # live.engine ranks above doc in the declared hierarchy
-            # (analysis/hierarchy.py): never call in with the doc lock
-            # held
+            # the emission domain (doc.emit) ranks above the doc lock
+            # in the declared hierarchy (analysis/hierarchy.py): never
+            # call in with the doc lock held
             patch = live.snapshot_patch(self)
             if patch is not None:
                 return patch
@@ -315,25 +327,14 @@ class DocBackend:
         )
         self.ready.push(True)
 
-    def _emission_lock(self):
-        """The lock serializing this doc's host-path {compute patch ->
-        push} pairs. With the live engine on it is the ENGINE lock —
-        the one lock every emission path holds, so a frontend callback
-        dispatched synchronously from a push that re-enters the repo
-        (open/change on this thread) recurses instead of deadlocking
-        against send_ready_atomic or a tick. HM_LIVE=0 (no engine)
-        falls back to the per-doc emit lock."""
-        live = self._live
-        return self._emit_lock if live is None else live.emission_lock
-
     def _handle_local(self, req: ChangeRequest) -> None:
         live = self._live
         if live is not None and self.opset is None:
             # lazy doc on the live path: resolve against the engine's
             # decoded state — no host OpSet reconstruction. The notify
-            # runs inside the engine lock (emit=) so the echo patch
-            # reaches the frontend queue before any tick's delta on the
-            # post-change state.
+            # runs inside THIS doc's emission domain (emit=) so the
+            # echo patch (feed append included) reaches the frontend
+            # queue before any tick's delta on the post-change state.
             def emit(change, patch):
                 self._notify(
                     {
@@ -352,7 +353,7 @@ class DocBackend:
             if res is not None:
                 self._check_ready()
                 return
-        with self._emission_lock():
+        with self.emission:
             with self._lock:
                 if self.opset is None:
                     self._ensure_opset()
@@ -380,7 +381,7 @@ class DocBackend:
             # engine emits the RemotePatch + readiness itself
             if live.submit_remote(self, changes):
                 return
-        with self._emission_lock():
+        with self.emission:
             with self._lock:
                 if self.opset is None:
                     self._ensure_opset()

@@ -17,14 +17,22 @@ Adoption (a bulk-loaded doc going hot) is lock-free: the O(doc) build
 (pack from sidecars, exact-size host kernel, lane-driven vectorized
 decode, winner-lane reachability) runs WITHOUT the engine lock —
 other hot docs keep ticking — and installs under it with a recheck
-(opset still None, serving clock unmoved, doc still open). The engine
-lock remains the ONE emission lock: every {compute patch -> push}
-pair holds it; the build computes no patch, so it is the one O(doc)
-stage allowed outside. HM_LIVE_MAX_BYTES byte-bounds resident
-LiveColumns: least-recently-ticked idle docs demote back to the lazy
-path after a tick and re-adopt from the sidecars on their next live
-change (demotion refuses docs whose state the sidecars cannot
-rebuild).
+(opset still None, serving clock unmoved, doc still open).
+
+Since the write-plane split (backend/emission.py) the engine lock is
+tick/dirty-set COORDINATION only. Emission ordering is PER DOC: every
+{compute patch -> feed append -> push} pair holds its own doc's
+`doc.emit` emission domain and nothing else ordered — disjoint docs'
+edits (and their durable WAL commits) proceed in parallel on
+different writer threads, and `lock.held_blocking_ms.live_engine`
+reads zero at every HM_FSYNC tier. The tick resolves each dirty doc
+with a GIL-atomic table snapshot and takes ONE domain at a time;
+catch-up kernel groups batch ACROSS docs with no locks held (the
+per-doc install-and-recheck discards a result the doc outran).
+HM_LIVE_MAX_BYTES byte-bounds resident LiveColumns: least-recently-
+ticked idle docs demote back to the lazy path after a tick and
+re-adopt from the sidecars on their next live change (demotion
+refuses docs whose state the sidecars cannot rebuild).
 
 Twin semantics (HM_LIVE=0 keeps the host-OpSet path):
 - causal admission (seq continuity + deps) mirrors OpSet's pending set
@@ -581,10 +589,9 @@ def _diff_states(old: _DocState, new: _DocState) -> List[Diff]:
 
 
 class _LiveDoc:
-    __slots__ = (
-        "doc", "cols", "state", "clock", "max_op", "history_len",
-        "pending", "queued", "last_use", "demotable_at",
-    )
+    # no __slots__: the HM_RACEDEP=1 lockset descriptors wrap these
+    # fields (analysis/guards.py declares them under doc.emit — the
+    # relocated engine-lock guard rows of the write-plane split)
 
     def __init__(self, doc, cols, state, clock, max_op, history_len):
         self.doc = doc
@@ -595,10 +602,15 @@ class _LiveDoc:
         self.history_len: int = history_len
         self.pending: Dict[Tuple[str, int], Change] = {}
         self.queued: List[Change] = []
+        # rows appended to `cols` but not yet decoded into `state`
+        # (tick phase 1 defers big catch-ups to the shared batched
+        # kernel; any reader under the domain catches up first)
+        self.undecoded: bool = False
+        self.tick_rows: int = 0  # phase-3 install-and-recheck token
         self.last_use: int = 0  # engine use-clock (LRU demotion order)
         # demotability memo: (serving clock at last check, verdict) —
-        # the sidecar serveability scan costs IO under the engine
-        # lock, so it runs at most once per clock value
+        # the sidecar serveability scan costs IO under the emission
+        # domain, so it runs at most once per clock value
         self.demotable_at: Optional[Tuple[Dict[str, int], bool]] = None
 
     def resident_bytes(self) -> int:
@@ -637,15 +649,15 @@ class LiveApplyEngine:
     def __init__(self, backend) -> None:
         self._back = backend
         self._lock = make_rlock("live.engine")
-        # `live.engine` — the TOP of the declared lock hierarchy
-        # (analysis/hierarchy.py) and the GLOBAL emission lock while
-        # the engine is on: every {compute patch -> push} pair — engine
-        # ticks, apply_local echoes, send_ready_atomic, and the host
-        # path's DocBackend emissions — runs under this one re-entrant
-        # lock, so frontend callbacks dispatched synchronously from a
-        # push can re-enter the repo without a second lock to deadlock
-        # against. It is a no-block class: fsync/socket-send/sqlite
-        # commit under it are lint + lockdep violations.
+        # `live.engine` — tick/dirty-set COORDINATION only since the
+        # write-plane split: the doc table and adoption/demotion
+        # bookkeeping mutate under it, and it is NEVER held across a
+        # feed append, fsync, or frontend push (those run under the
+        # per-doc emission domains, backend/emission.py, which rank
+        # ABOVE it). It stays a no-block class: any blocking call
+        # under it is a lint + lockdep violation, and bench
+        # config_lockdebt pins lock.held_blocking_ms.live_engine at
+        # zero for every HM_FSYNC tier.
         self._docs: Dict[str, _LiveDoc] = {}
         self._refused: Set[str] = set()  # adoption failed: host path
         # in-flight adoptions (doc_id -> gate). Builds run OUTSIDE the
@@ -685,11 +697,6 @@ class LiveApplyEngine:
         )
 
     @property
-    def emission_lock(self) -> threading.RLock:
-        """The lock host-path emissions must hold (see __init__)."""
-        return self._lock
-
-    @property
     def stats(self) -> Dict[str, Any]:
         """The engine's stats as the historical dict (registry-backed;
         read-only — a write to the returned dict mutates a copy)."""
@@ -709,15 +716,16 @@ class LiveApplyEngine:
     def submit_remote(self, doc, changes: List[Change]) -> bool:
         """Admit + queue remote changes for the next tick. False when
         the doc cannot be live-managed (caller takes the host path).
-        Adoption (if needed) builds outside the engine lock."""
+        Adoption (if needed) builds outside every ordered lock."""
         while True:
             if self._ensure_doc(doc) is None:
                 return False
-            with self._lock:
-                ld = self._docs.get(doc.id)
-                if ld is None:
-                    continue  # demoted in the gap: re-adopt
-                ld.last_use = self._bump_use()
+            with doc.emission:
+                with self._lock:
+                    if self._docs.get(doc.id) is None:
+                        continue  # demoted in the gap: re-adopt
+                    ld = self._docs[doc.id]
+                    ld.last_use = self._bump_use()
                 if self._admit(ld, changes):
                     self._sync_doc_meta(ld)
                     self._ticker.mark(doc.id)
@@ -732,28 +740,27 @@ class LiveApplyEngine:
         (OpSet.apply_local_request twin). None when the doc cannot be
         live-managed; raises ValueError on an out-of-order seq.
 
-        `emit(change, patch)` runs while the engine lock is STILL held:
-        the patch's diffs are relative to the state just before this
-        change, so its push must reach the frontend queue before any
-        tick emits a delta on the post-change state — same ordering
-        contract as send_ready_atomic."""
+        `emit(change, patch)` runs while the doc's EMISSION DOMAIN is
+        still held: the patch's diffs are relative to the state just
+        before this change, so its push (feed append included) must
+        reach the frontend queue before any tick emits a delta on the
+        post-change state. Only THIS doc's domain is held — disjoint
+        docs' local changes run concurrently."""
         while True:
             if self._ensure_doc(doc) is None:
                 return None
-            with self._lock:
-                ld = self._docs.get(doc.id)
-                if ld is None:
-                    continue  # demoted in the gap: re-adopt
-                ld.last_use = self._bump_use()
+            with doc.emission:
+                with self._lock:
+                    ld = self._docs.get(doc.id)
+                    if ld is None:
+                        continue  # demoted in the gap: re-adopt
+                    ld.last_use = self._bump_use()
                 # pending admitted remotes apply (and notify) first, so
                 # the local resolution sees the same state the host
-                # path would
-                self._flush_ids([doc.id])
-                # the flush may have evicted the doc to the host path
-                # (_evict_to_host pops it and rebuilds the OpSet) — the
-                # old _LiveDoc is orphaned; the caller retries host-side
-                ld = self._docs.get(doc.id)
-                if ld is None:
+                # path would. The catch-up may evict the doc to the
+                # host path (range overflow) — the caller retries
+                # host-side.
+                if not self._catch_up_locked(ld):
                     return None
                 expected = ld.clock.get(req.actor, 0) + 1
                 if req.seq != expected:
@@ -770,15 +777,17 @@ class LiveApplyEngine:
 
     def snapshot_patch(self, doc) -> Optional[Patch]:
         """From-scratch patch of the live state (OpSet.snapshot_patch
-        twin — served for Ready / reopen on adopted docs)."""
-        with self._lock:
+        twin — served for Ready / reopen on adopted docs). Holding the
+        doc's emission domain across {snapshot -> push} is the Ready
+        atomicity contract: no tick can slip a newer delta ahead of
+        the Ready in the frontend queue, because every tick emission
+        of this doc needs this same domain."""
+        with doc.emission:
             ld = self._docs.get(doc.id)
             if ld is None:
                 return None
-            self._flush_ids([doc.id])
-            ld = self._docs.get(doc.id)  # flush may evict to host path
-            if ld is None:
-                return None
+            if not self._catch_up_locked(ld):
+                return None  # evicted to the host path mid-flush
             # diff against an empty doc WITHOUT touching the tracked
             # reachability (this is a read, not an emission to the
             # incremental patch stream)
@@ -791,35 +800,6 @@ class LiveApplyEngine:
                 max_op=ld.max_op,
                 diffs=tuple(diffs),
             )
-
-    def send_ready_atomic(self, doc, push, host_snapshot) -> None:
-        """Compute the doc's Ready snapshot and hand it to `push` while
-        STILL holding the engine lock. Ordering contract with the
-        frontend: a pending frontend drops every patch that precedes its
-        Ready in the queue (the snapshot carries their effects), so no
-        tick may interleave a delta for a NEWER state ahead of the Ready
-        push — holding the lock across the push guarantees it.
-
-        Docs the engine does not own snapshot host-side via
-        `host_snapshot()` — ALSO under the engine lock, which blocks a
-        concurrent adoption (its INSTALL, and any tick after it, needs
-        this lock — the lock-free build alone cannot emit) from
-        ticking a delta between the snapshot and the push. With the
-        engine on, the
-        engine lock IS the host-path emission lock too (DocBackend
-        routes its {compute -> push} pairs through emission_lock), so
-        holding it here serializes against host-path emissions as
-        well. ONE re-entrant lock guards every emission: a frontend
-        callback that re-enters the repo on the emitting thread just
-        recurses, and no second lock exists to invert against (the
-        per-doc _emit_lock is only used by the HM_LIVE=0 twin, where
-        no engine lock exists)."""
-        with self._lock:  # re-entrant: snapshot_patch retakes it
-            patch = self.snapshot_patch(doc)
-            if patch is not None:
-                push(patch)
-                return
-            push(host_snapshot())
 
     def drop(self, doc_id: str) -> None:
         """Forget a doc's live state (close/destroy)."""
@@ -853,19 +833,19 @@ class LiveApplyEngine:
         + decode, O(doc)) runs lock-FREE so other hot docs keep ticking
         through the window, then installs under the lock with a recheck
         (opset still None, serving clock unmoved, doc still open). The
-        ONE-emission-lock invariant holds because the build never
-        computes or pushes a patch — only the install (and every
-        emission) takes the engine lock. Returns None for the host
-        path (refused, recursive adoption window, emission re-entry,
-        or doc closed)."""
-        # a thread that already HOLDS the emission lock (a frontend
-        # callback dispatched synchronously from a push re-entered the
-        # repo mid-emission) must neither build here (an O(doc) build
-        # under the lock is the stall this rework removes) nor wait on
-        # another thread's gate (that builder needs this lock to
-        # install/finish — waiting with it held deadlocks every
-        # emission in the repo). Host path instead, the same answer as
-        # the recursive-window case below.
+        emission-ordering invariant holds because the build never
+        computes or pushes a patch — only the install takes the
+        engine lock, and every emission takes the doc's domain.
+        Returns None for the host path (refused, recursive adoption
+        window, engine-lock re-entry, or doc closed)."""
+        # a thread that already HOLDS the engine lock must neither
+        # build here (an O(doc) build under the coordination lock
+        # stalls every tick) nor wait on another thread's gate (that
+        # builder needs this lock to install/finish — waiting with it
+        # held deadlocks the engine). Host path instead, the same
+        # answer as the recursive-window case below. Holding this
+        # doc's own EMISSION DOMAIN is fine: the builder never takes
+        # another doc's domain.
         held = getattr(self._lock, "_is_owned", lambda: False)()
         while True:
             with self._lock:
@@ -918,14 +898,11 @@ class LiveApplyEngine:
                 if outcome == "refused":
                     self._refused.add(doc.id)
                     self._m["refused"].add(1)
-                    # doc._live stays SET: _emission_lock must keep
-                    # returning the engine lock for this doc's host-path
-                    # emissions, or a refused doc's patches and its
-                    # engine-locked Ready (send_ready_atomic) would be
-                    # guarded by different locks and could interleave.
-                    # The host path is still taken — the opset the
-                    # fallback installs short-circuits the live branch,
-                    # and _refused rejects re-adoption.
+                    # doc._live stays SET (harmless): the host path is
+                    # still taken — the opset the fallback installs
+                    # short-circuits the live branch, and _refused
+                    # rejects re-adoption. Emission ordering is the
+                    # doc's own domain either way.
                 # the install window is lock-HELD: keep the two stats
                 # disjoint so lock_free + lock_held = build wall
                 self._m["t_adopt_lock_free"].add(
@@ -1011,27 +988,31 @@ class LiveApplyEngine:
             if doc.id in self._demoted_ids:
                 self._demoted_ids.discard(doc.id)
                 self._m["readopted"].add(1)
-            self._enforce_budget_locked()
             self._m["t_adopt_lock_held"].add(now() - t0)
+        # budget enforcement OUTSIDE the engine lock: a demotion takes
+        # {domain -> engine}, so running it with the engine held would
+        # invert the declared order
+        self._enforce_budget()
         return "ok", ld
 
     # ------------------------------------------------------------------
     # byte-bounded LRU demotion (HM_LIVE_MAX_BYTES)
 
-    def _enforce_budget_locked(self) -> None:
+    def _enforce_budget(self) -> None:
         """Demote least-recently-used idle docs until resident bytes
         fit HM_LIVE_MAX_BYTES (0 = unbounded — the pass costs O(1)
         then; `live_bytes` only refreshes while a cap is set). The
         most recently used doc is never demoted by this pass — a
         single hot doc larger than the cap must not thrash an O(doc)
         adopt/demote cycle on every tick — so the effective floor is
-        one doc's bytes. Dirty docs (queued/pending changes) wait for
-        their tick. REQUIRES live.engine (analysis/guards.py)."""
+        one doc's bytes. Dirty docs (queued/pending/undecoded) wait
+        for their tick."""
         cap = _live_max_bytes()
         if cap <= 0:
-            self._m["live_docs"].set(len(self._docs))
+            with self._lock:
+                self._m["live_docs"].set(len(self._docs))
             return
-        self._demote_pass(cap, protect_mru=True)
+        self._demote_over(cap, protect_mru=True)
 
     def demote_idle(self, max_bytes: Optional[int] = None) -> int:
         """Demote idle adopted docs (LRU-first) until resident bytes
@@ -1041,41 +1022,68 @@ class LiveApplyEngine:
         doc too. Returns the number demoted — docs with un-ticked
         changes, or whose state cannot be rebuilt from the sidecars,
         stay resident."""
-        with self._lock:
-            if max_bytes is not None:
-                cap = max_bytes
-            else:
-                cap = _live_max_bytes()
-                if cap <= 0:
-                    return 0  # unbounded cap: nothing to enforce
-            return self._demote_pass(cap, protect_mru=False)
+        if max_bytes is not None:
+            cap = max_bytes
+        else:
+            cap = _live_max_bytes()
+            if cap <= 0:
+                return 0  # unbounded cap: nothing to enforce
+        return self._demote_over(cap, protect_mru=False)
 
-    def _demote_pass(self, cap: int, protect_mru: bool) -> int:
+    def _demote_over(self, cap: int, protect_mru: bool) -> int:
         """ONE LRU demotion sweep shared by the per-tick budget pass
         (protect_mru=True) and the explicit demote_idle hook; returns
-        the number demoted. REQUIRES live.engine (analysis/guards.py)."""
+        the number demoted. Candidates snapshot under the engine
+        lock; each demotion re-locks {domain -> engine} and rechecks
+        — the domain-before-engine order means the sweep can never
+        hold the engine lock while waiting on a busy writer."""
+        with self._lock:
+            candidates, sizes, total, mru = (
+                self._demote_candidates_locked(protect_mru)
+            )
+        n0 = self._m["demoted"].value()
+        if total > cap:
+            for ld in candidates:
+                if total <= cap:
+                    break
+                if ld is mru:
+                    continue
+                if self._demote_one(ld):
+                    total -= sizes[ld.doc.id]
+        self._m["live_bytes"].set(total)
+        with self._lock:
+            self._m["live_docs"].set(len(self._docs))
+        return int(self._m["demoted"].value() - n0)
+
+    def _demote_candidates_locked(self, protect_mru: bool):
+        """LRU-ordered demotion candidates + byte accounting.
+        REQUIRES live.engine (analysis/guards.py)."""
         docs = self._docs
         sizes = {i: ld.resident_bytes() for i, ld in docs.items()}
         total = sum(sizes.values())
-        n0 = self._m["demoted"].value()
-        if docs and total > cap:
-            mru = (
-                max(docs.values(), key=lambda l: l.last_use)
-                if protect_mru
-                else None
-            )
-            for ld in sorted(docs.values(), key=lambda l: l.last_use):
-                if total <= cap:
-                    break
-                if ld is mru or ld.queued or ld.pending:
-                    continue
+        mru = (
+            max(docs.values(), key=lambda l: l.last_use)
+            if (docs and protect_mru)
+            else None
+        )
+        order = sorted(docs.values(), key=lambda l: l.last_use)
+        return order, sizes, total, mru
+
+    def _demote_one(self, ld: _LiveDoc) -> bool:
+        """Demote one candidate if it is still present, idle, and
+        rebuildable — under its domain (no emission can be mid-flight)
+        plus the engine lock (table mutation)."""
+        doc = ld.doc
+        with doc.emission:
+            with self._lock:
+                if self._docs.get(doc.id) is not ld:
+                    return False
+                if ld.queued or ld.pending or ld.undecoded:
+                    return False
                 if not self._demotable(ld):
-                    continue
+                    return False
                 self._demote_locked(ld)
-                total -= sizes[ld.doc.id]
-        self._m["live_bytes"].set(total)
-        self._m["live_docs"].set(len(docs))
-        return int(self._m["demoted"].value() - n0)
+                return True
 
     def _demotable(self, ld: _LiveDoc) -> bool:
         """Re-adoption must be able to rebuild this exact state from
@@ -1085,11 +1093,10 @@ class LiveApplyEngine:
         (synthetic peers, tests) pin the doc resident — demoting would
         silently lose them. The verdict memoizes per serving clock
         (either way), so over-budget ticks do not re-pay the sidecar
-        scans — the scan runs under the engine lock, the repo's one
-        emission lock. If a sidecar regresses OUT-OF-BAND after a
-        positive memo, re-adoption still re-checks serveability and
-        falls back to the host path, so a stale verdict degrades, not
-        corrupts."""
+        scans — the scan runs under the doc's emission domain. If a
+        sidecar regresses OUT-OF-BAND after a positive memo,
+        re-adoption still re-checks serveability and falls back to
+        the host path, so a stale verdict degrades, not corrupts."""
         doc = ld.doc
         with doc._lock:
             if doc._lazy_loader is None:
@@ -1108,7 +1115,8 @@ class LiveApplyEngine:
         doc's next live change re-adopts from the sidecars (cheap: the
         vectorized decode). Reads keep working — a fresh lazy snapshot
         closure replaces the engine's state for Ready/reopen. Caller
-        holds the engine lock (REQUIRES live.engine, analysis/guards.py)."""
+        holds the doc's emission domain AND the engine lock
+        (REQUIRES live.engine, analysis/guards.py)."""
         doc = ld.doc
         log("live", f"demoting {doc.id[:6]} to lazy (LRU)")
         telemetry.instant("live.demote", cat="live")
@@ -1168,51 +1176,72 @@ class LiveApplyEngine:
 
     def _on_tick(self, marked: Dict) -> None:
         with telemetry.span("live.tick", cat="live"):
-            with self._lock:
-                self._flush_ids(list(marked))
-                self._enforce_budget_locked()
+            m = self._m
+            kernel_docs: List[_LiveDoc] = []
+            ticked = 0
+            for doc_id in list(marked):
+                # GIL-atomic table snapshot: the tick NEVER holds the
+                # engine lock while acquiring a doc's domain (and
+                # never two domains at once — the no-cross-doc
+                # invariant of the write plane)
+                ld = self._docs.get(doc_id)
+                if ld is None:
+                    continue
+                with ld.doc.emission:
+                    with self._lock:
+                        if self._docs.get(doc_id) is not ld:
+                            continue  # demoted/evicted before we got in
+                        ld.last_use = self._bump_use()
+                    res = self._tick_doc_locked(ld)
+                    if res:
+                        ticked += 1
+                    if res == 2:
+                        kernel_docs.append(ld)
+            if ticked:
+                m["ticks"].add(1)
+                m["tick_docs"].add(ticked)
+            if kernel_docs:
+                # shape buckets: docs whose row counts share a pow2
+                # bucket ride one padded dispatch (and successive
+                # ticks reuse its program)
+                from ..ops.crdt_kernels import LIVE_MIN_ROWS, live_bucket
 
-    def _flush_ids(self, doc_ids: List[str]) -> None:
-        """Apply every queued change of the named docs; emit one delta
-        patch per doc. Small ticks apply INCREMENTALLY — O(tick ops)
-        direct state application through the OpSet-twin _apply_op_state
-        (the ROADMAP'd row-delta constant: a trickle of edits must not
-        pay an O(doc) kernel+decode+diff per tick). Big catch-up ticks
-        (ops x rows over the budget) take the shape-bucketed kernel
-        dispatch, where the vectorized rebuild amortizes. REQUIRES
-        live.engine (analysis/guards.py)."""
+                groups: Dict[int, List[_LiveDoc]] = {}
+                for ld in kernel_docs:
+                    groups.setdefault(
+                        live_bucket(ld.tick_rows, LIVE_MIN_ROWS), []
+                    ).append(ld)
+                for bucket_n, lds in sorted(groups.items()):
+                    self._run_group(bucket_n, lds)
+            self._enforce_budget()
+
+    def _tick_doc_locked(self, ld: _LiveDoc) -> int:
+        """Tick phase 1 for ONE doc, under its emission domain: append
+        its queued changes and either apply them incrementally (small
+        ticks — O(tick ops) through the OpSet-twin _apply_op_state —
+        complete here, patch emitted) or mark the doc `undecoded` for
+        the shared batched kernel: phase 2 dispatches across docs with
+        NO locks held, phase 3 installs per doc back under this
+        domain. Returns 0 = no work, 1 = done inline, 2 = joined the
+        kernel group. REQUIRES doc.emit (analysis/guards.py)."""
         now = time.perf_counter
-        dirty = [
-            self._docs[d]
-            for d in doc_ids
-            if d in self._docs and self._docs[d].queued
-        ]
-        if not dirty:
-            return
         m = self._m
-        t0 = now()
-        batches = []
-        for ld in dirty:
-            ld.last_use = self._bump_use()
-            changes = ld.queued
+        changes = ld.queued
+        if not changes and not ld.undecoded:
+            return 0
+        if changes:
             ld.queued = []
             m["tick_changes"].add(len(changes))
+            t0 = now()
             ld.cols.append_changes(changes)
+            m["t_live_append"].add(now() - t0)
             if not self._ranges_ok(ld.cols):
                 self._evict_to_host(ld)
-                continue
-            batches.append((ld, changes))
-        m["t_live_append"].add(now() - t0)
-        m["ticks"].add(1)
-        m["tick_docs"].add(len(batches))
-
-        budget = _inc_budget_cells()
-        kernel_docs: List[_LiveDoc] = []
-        for ld, changes in batches:
-            n_ops = sum(len(c.ops) for c in changes)
-            if n_ops > 8 and n_ops * max(ld.cols.n, 1) > budget:
-                kernel_docs.append(ld)
-                continue
+                return 1
+        n_ops = sum(len(c.ops) for c in changes)
+        if not ld.undecoded and (
+            n_ops <= 8 or n_ops * max(ld.cols.n, 1) <= _inc_budget_cells()
+        ):
             t1 = now()
             diffs: List[Diff] = []
             for c in changes:
@@ -1221,20 +1250,52 @@ class LiveApplyEngine:
             m["inc_changes"].add(len(changes))
             m["t_live_apply"].add(now() - t1)
             self._emit_tick(ld, diffs)
-        if not kernel_docs:
-            return
+            return 1
+        ld.undecoded = True
+        ld.tick_rows = ld.cols.n
+        return 2
 
-        # shape buckets: docs whose row counts share a pow2 bucket ride
-        # one padded dispatch (and successive ticks reuse its program)
+    def _catch_up_locked(self, ld: _LiveDoc) -> bool:
+        """Bring ld.state current under its emission domain: apply the
+        queued changes and decode any appended-but-undecoded rows,
+        emitting the coalesced delta patch — the per-doc successor of
+        the old engine-locked _flush_ids. Returns False when the doc
+        was evicted to the host path (the caller retries host-side).
+        REQUIRES doc.emit (analysis/guards.py)."""
+        state = self._tick_doc_locked(ld)
+        if state == 1 and self._docs.get(ld.doc.id) is not ld:
+            return False  # _evict_to_host handed it to the host path
+        if not ld.undecoded:
+            return True
+        # single-doc catch-up: the same bucketed kernel the tick group
+        # uses (device when the padded shape clears the min-cells bar)
         from ..ops.crdt_kernels import LIVE_MIN_ROWS, live_bucket
 
-        groups: Dict[int, List[_LiveDoc]] = {}
-        for ld in kernel_docs:
-            groups.setdefault(
-                live_bucket(ld.cols.n, LIVE_MIN_ROWS), []
-            ).append(ld)
-        for bucket_n, lds in sorted(groups.items()):
-            self._run_group(bucket_n, lds)
+        now = time.perf_counter
+        t0 = now()
+        lanes = self._kernel(
+            live_bucket(ld.cols.n, LIVE_MIN_ROWS), [ld]
+        )[0]
+        self._m["t_live_kernel"].add(now() - t0)
+        self._decode_install_locked(ld, lanes)
+        return True
+
+    def _decode_install_locked(self, ld: _LiveDoc, lanes) -> None:
+        """Decode kernel lanes into a fresh state, diff, install, and
+        emit — the shared tail of the catch-up paths. Caller holds the
+        doc's emission domain."""
+        now = time.perf_counter
+        m = self._m
+        t1 = now()
+        with _gc_paused():
+            new_state = _decode_state(ld.cols, lanes)
+        t2 = now()
+        diffs = _diff_states(ld.state, new_state)
+        ld.state = new_state
+        ld.undecoded = False
+        m["t_live_decode"].add(t2 - t1)
+        m["t_live_diff"].add(now() - t2)
+        self._emit_tick(ld, diffs)
 
     def _emit_tick(self, ld: _LiveDoc, diffs: List[Diff]) -> None:
         self._sync_doc_meta(ld)
@@ -1252,21 +1313,30 @@ class LiveApplyEngine:
         doc._check_ready()
 
     def _run_group(self, bucket_n: int, lds: List[_LiveDoc]) -> None:
+        """Tick phases 2+3 for one shape bucket: ONE batched kernel
+        dispatch across the group's docs with NO locks held (rows
+        under each doc's phase-1 snapshot are immutable — LiveColumns
+        appends publish `n` last), then a per-doc install back under
+        its emission domain with a recheck: a doc a writer caught up
+        (or evicted/closed) mid-kernel discards its stale lanes."""
         now = time.perf_counter
         m = self._m
         t0 = now()
         lanes_by_doc = self._kernel(bucket_n, lds)
         m["t_live_kernel"].add(now() - t0)
         for ld, lanes in zip(lds, lanes_by_doc):
-            t1 = now()
-            with _gc_paused():
-                new_state = _decode_state(ld.cols, lanes)
-            t2 = now()
-            diffs = _diff_states(ld.state, new_state)
-            ld.state = new_state
-            m["t_live_decode"].add(t2 - t1)
-            m["t_live_diff"].add(now() - t2)
-            self._emit_tick(ld, diffs)
+            with ld.doc.emission:
+                if not ld.undecoded:
+                    continue  # a writer's catch-up beat us to it
+                with self._lock:
+                    if self._docs.get(ld.doc.id) is not ld:
+                        continue  # dropped/demoted mid-kernel
+                if ld.cols.n != ld.tick_rows:
+                    # rows landed after the snapshot: redo at the
+                    # current shape instead of installing stale lanes
+                    self._catch_up_locked(ld)
+                    continue
+                self._decode_install_locked(ld, lanes)
 
     def _kernel(self, bucket_n: int, lds: List[_LiveDoc]):
         """Run the materialize kernel over the group; returns one lane
@@ -1357,11 +1427,13 @@ class LiveApplyEngine:
         the host OpSet path. Everything admitted is already in the
         feeds, so the explicit replay (at the serving clock) rebuilds
         the exact state; un-admitted pending changes re-queue so none
-        is lost."""
+        is lost. Caller holds the doc's emission domain; the table
+        mutation takes the engine lock inside it."""
         doc = ld.doc
         log("live", f"evicting {doc.id[:6]} to host path (range)")
-        self._docs.pop(doc.id, None)
-        self._refused.add(doc.id)
+        with self._lock:
+            self._docs.pop(doc.id, None)
+            self._refused.add(doc.id)
         with doc._lock:
             # doc._live stays set (see _ensure_doc): emissions keep the
             # engine lock so the Ready ordering contract holds

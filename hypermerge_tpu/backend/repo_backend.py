@@ -127,17 +127,65 @@ class RepoBackend:
         # ahead, reconcile sqlite clock rows with feed reality. Runs
         # BEFORE the clock mirror seeds and before any doc opens.
         self.recovery_report: Optional[Dict] = None
+        recovery_skipped = False
         if was_dirty and os.environ.get("HM_RECOVER", "1") != "0":
             from ..storage.scrub import recover_repo
 
             self.recovery_report = recover_repo(self)
-        if self._dirty_marker is not None:
+        elif was_dirty:
+            recovery_skipped = True
+        # shared group-commit journal (storage/wal.py): created AFTER
+        # recovery consumed the crashed session's journal. With
+        # recovery explicitly skipped (HM_RECOVER=0 — tools/scrub.py
+        # drives it manually) the crashed journal must survive for
+        # that manual pass, so this session runs journal-less and
+        # durable appends take the legacy per-feed path. Same when
+        # recovery RAN but a replayed feed's fsync failed: the old
+        # journal is the only durable copy of those records, and a
+        # fresh WriteAheadLog at the same path would truncate it.
+        wal_rep = (self.recovery_report or {}).get("wal") or {}
+        replay_incomplete = bool(wal_rep.get("replay_sync_failed"))
+        if not memory and not recovery_skipped and not replay_incomplete:
+            from ..storage.wal import WriteAheadLog, wal_enabled
+
+            if wal_enabled():
+                try:
+                    self.durability.attach_wal(
+                        WriteAheadLog(
+                            os.path.join(path, "wal.log"),
+                            self.durability.tier,
+                        )
+                    )
+                except OSError as e:
+                    log("repo:backend", f"no write-ahead journal: {e}")
+        if recovery_skipped and self._dirty_marker is not None:
+            # the preserved stamp bounds a FUTURE recovery's scan to
+            # the crashed session's dirty ledger — sound only while
+            # that ledger covers all damage. The first journal-less
+            # feed write of THIS session breaks that: invalidate the
+            # stamp then (not at open — a read-only manual-scrub
+            # session must leave it byte-for-byte intact).
+            self.durability.journalless_write_cb = (
+                self._invalidate_recovery_stamp
+            )
+        if self._dirty_marker is not None and not recovery_skipped:
             from ..storage.faults import io_fsync, io_open
 
             # the marker must be DURABLE: if a power cut erased it,
             # reopen would silently skip recovery — and tier 0 depends
-            # on recovery-on-open to reconcile clocks with feeds
+            # on recovery-on-open to reconcile clocks with feeds. Its
+            # CONTENT is the journal's session id (the generation
+            # stamp): recovery bounds its scan to the journal's dirty
+            # ledger only when marker and journal header agree. With
+            # recovery explicitly skipped (HM_RECOVER=0) the CRASHED
+            # session's marker+stamp must survive untouched, or the
+            # manual tools/scrub.py pass would lose both the crash
+            # evidence and the scan bounding.
             with io_open(self._dirty_marker, "wb") as fh:
+                if self.durability.wal is not None:
+                    fh.write(
+                        self.durability.wal.session.encode("utf-8")
+                    )
                 io_fsync(fh)
             self._fsync_dir(path)
         if os.environ.get("HM_CLOCK_MIRROR", "1") != "0":
@@ -267,6 +315,36 @@ class RepoBackend:
                 os.close(fd)
         except OSError:
             pass
+
+    def _invalidate_recovery_stamp(self) -> None:
+        """First feed write of a journal-less HM_RECOVER=0 session
+        (storage/durability.py journalless_write_cb): the crashed
+        session's marker+journal were preserved for a manual scrub,
+        but this session's writes are OUTSIDE that journal's dirty
+        ledger — append a suffix so the stamp stops matching the
+        journal header. A crash of THIS session then recovers with
+        the full sidecar scan (and still replays the old journal,
+        which is session-match independent) instead of trusting a
+        ledger that never saw the new damage. The marker itself — the
+        crash evidence — survives."""
+        if self._dirty_marker is None:
+            return
+        from ..storage.faults import io_fsync, io_open
+
+        try:
+            prev = b""
+            try:
+                with open(self._dirty_marker, "rb") as fh:
+                    prev = fh.read()
+            except OSError:
+                pass
+            if prev.endswith(b"+journalless"):
+                return
+            with io_open(self._dirty_marker, "wb") as fh:
+                fh.write(prev + b"+journalless")
+                io_fsync(fh)
+        except OSError as e:
+            log("repo:backend", f"stamp invalidation failed: {e}")
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -1695,6 +1773,16 @@ class RepoBackend:
             actor = self.actors.get(change.actor)
             if actor is not None and actor.writable:
                 actor.write_change(change)
+                if self.durability.tier == 1 and (
+                    self.durability.ack_durable
+                ):
+                    # HM_ACK_DURABLE=1: the echo below is a DURABLE
+                    # ack — wait for the WAL group commit covering the
+                    # append. Runs under THIS doc's emission domain
+                    # only (doc.emit may block); concurrent writers'
+                    # waits share the leader's one fsync per HM_WAL_MS
+                    # window.
+                    self.durability.commit_ack()
             else:
                 log("repo:backend", "no writable actor for", change.actor[:6])
             self._mark_clock_row(doc)
@@ -1734,23 +1822,31 @@ class RepoBackend:
                 )
             )
 
-        # with the live engine on, BOTH paths run under the engine lock
-        # (live.send_ready_atomic): engine-owned docs so no tick can
-        # slip a newer delta ahead of the Ready in the queue, and
-        # host-side docs so a racing adoption can't start ticking
-        # between the snapshot and the push (a pending frontend drops
-        # pre-Ready patches — live.py contract). The engine lock is the
-        # ONLY emission lock while the engine is on (DocBackend's host
-        # paths route through it too, via _emission_lock) — there is no
-        # second lock for a synchronously-dispatched frontend callback
-        # to invert against.
-        if self.live is not None:
-            self.live.send_ready_atomic(doc, push, doc.snapshot_patch)
+        # Ready atomicity is PER DOC since the write-plane split:
+        # holding this doc's emission domain across {snapshot -> push}
+        # means no tick, local echo, or remote handler can slip a patch
+        # for a NEWER state of THIS doc ahead of the Ready in the
+        # frontend queue (a pending frontend drops pre-Ready patches).
+        # Both the engine path (live.snapshot_patch re-enters the same
+        # re-entrant domain) and the host twin hold only this one
+        # domain — disjoint docs' Readys and emissions run in parallel.
+        # Cross-doc re-entry (a frontend callback dispatched from doc
+        # A's patch push Opens doc B on the same thread) must NOT nest
+        # B's domain under A's: park the Ready on the deferred-emission
+        # worker. Safe to delay — the frontend stays pending and drops
+        # pre-Ready patches, so the deferred Ready still delivers a
+        # full snapshot.
+        from . import emission
+
+        if emission.entered_other(doc.id):
+            emission.defer(lambda: self._send_ready(doc))
             return
-        # host twin (HM_LIVE=0): atomicity via the doc's emission lock —
-        # a concurrent _handle_remote/_handle_local cannot push a patch
-        # for a state newer than this snapshot before the Ready lands
-        with doc._emit_lock:
+        with doc.emission:
+            if self.live is not None:
+                patch = self.live.snapshot_patch(doc)
+                if patch is not None:
+                    push(patch)
+                    return
             push(doc.snapshot_patch())
 
     def _actor_notify(self, event: Dict[str, Any]) -> None:

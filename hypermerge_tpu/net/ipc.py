@@ -26,8 +26,9 @@ from __future__ import annotations
 import os
 import socket
 import threading
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, Optional, Set, Tuple
 
+from ..analysis.lockdep import make_lock
 from .tcp import TcpDuplex
 
 
@@ -93,6 +94,127 @@ class ReplyFence:
         return fn
 
 
+class _FrontendHub:
+    """Many frontends, ONE daemon backend — the connection/interest
+    table behind `serve_backend(hub=True)` (`--hub`), and the process
+    topology bench `config_writers` measures: N writer processes
+    editing disjoint docs against one backend, whose per-doc emission
+    domains (backend/emission.py) let their {patch -> feed append ->
+    WAL commit -> push} pipelines run concurrently.
+
+    Each accepted frontend gets a connection key. Its Query ids are
+    tagged `[key, raw]` so Replies route back to the issuing frontend
+    only (the ReplyFence trick, per connection instead of per epoch —
+    every frontend's queryId counter starts at the same small
+    integers). Doc-addressed pushes (Ready/Patch/ActorId/Download/...)
+    route by INTEREST: a frontend that named a doc id in any message
+    (Open/Create/Request/...) receives that doc's pushes, and
+    disjoint-doc writers never see each other's patch traffic; Close/
+    Destroy retires the interest. Un-addressed pushes broadcast.
+    Supported write topology: ONE writing frontend per doc (any number
+    of watchers) — the backend grants one writable actor per doc, so
+    two connections editing the same doc would collide on its seq
+    counter. Concurrent same-doc writers belong on separate daemons
+    joined by replication (the reference design); hub mode's
+    concurrency win is disjoint docs.
+    Socket sends run OUTSIDE the hub lock (`net.ipc.hub`,
+    analysis/hierarchy.py): a slow frontend must not stall accepts or
+    another connection's teardown."""
+
+    def __init__(self, back) -> None:
+        self._back = back
+        self._lock = make_lock("net.ipc.hub")
+        self._conns: Dict[int, TcpDuplex] = {}
+        self._interest: Dict[str, Set[int]] = {}  # doc id -> conn keys
+        self._next_key = 0
+
+    def attach(self, duplex: TcpDuplex) -> None:
+        with self._lock:
+            self._next_key += 1
+            key = self._next_key
+            self._conns[key] = duplex
+        duplex.on_close(lambda _k=key: self._detach(_k))
+        duplex.on_message(lambda msg, _k=key: self._inbound(_k, msg))
+
+    def _detach(self, key: int) -> None:
+        with self._lock:
+            self._conns.pop(key, None)
+            # drop doc entries whose last watcher left — a long-lived
+            # daemon's interest table must track LIVE interest, not
+            # every doc id ever named (it would grow monotonically
+            # with lifetime doc count otherwise)
+            emptied = []
+            for doc_id, keys in self._interest.items():
+                keys.discard(key)
+                if not keys:
+                    emptied.append(doc_id)
+            for doc_id in emptied:
+                del self._interest[doc_id]
+
+    def _inbound(self, key: int, msg) -> None:
+        if isinstance(msg, dict):
+            t = msg.get("type")
+            doc_id = (
+                msg.get("publicKey") if t == "Create" else msg.get("id")
+            )
+            with self._lock:
+                if doc_id is not None:
+                    if t in ("Close", "Destroy"):
+                        keys = self._interest.get(doc_id)
+                        if keys is not None:
+                            keys.discard(key)
+                            if not keys:
+                                del self._interest[doc_id]
+                    else:
+                        self._interest.setdefault(doc_id, set()).add(key)
+                if t == "OpenBulk":
+                    for i in msg.get("ids", ()):
+                        self._interest.setdefault(i, set()).add(key)
+            if t == "Query":
+                msg = dict(msg)
+                msg["queryId"] = [key, msg["queryId"]]
+        self._back.receive(msg)
+
+    def dispatch(self, msg) -> None:
+        """The ONE to_frontend subscriber: Replies to their issuing
+        connection, doc-addressed pushes to the interested
+        connections, everything else to everyone."""
+        if isinstance(msg, dict):
+            if msg.get("type") == "Reply":
+                qid = msg.get("queryId")
+                if not (isinstance(qid, list) and len(qid) == 2):
+                    return  # not hub-tagged: no route back
+                with self._lock:
+                    duplex = self._conns.get(qid[0])
+                if duplex is not None:
+                    out = dict(msg)
+                    out["queryId"] = qid[1]
+                    self._send(duplex, out)
+                return
+            doc_id = msg.get("id")
+            if doc_id is not None:
+                with self._lock:
+                    targets = [
+                        self._conns[k]
+                        for k in self._interest.get(doc_id, ())
+                        if k in self._conns
+                    ]
+                for duplex in targets:
+                    self._send(duplex, msg)
+                return
+        with self._lock:
+            targets = list(self._conns.values())
+        for duplex in targets:
+            self._send(duplex, msg)
+
+    @staticmethod
+    def _send(duplex: TcpDuplex, msg) -> None:
+        try:
+            duplex.send(msg)
+        except OSError:
+            pass  # the duplex's on_close detach reaps the connection
+
+
 def serve_backend(
     sock_path: str,
     repo_path: Optional[str] = None,
@@ -100,6 +222,7 @@ def serve_backend(
     once: bool = True,
     tcp_listen: bool = False,
     tcp_connect: Optional[list] = None,
+    hub: bool = False,
 ) -> None:
     """Host a RepoBackend behind a unix socket. `once` serves a single
     frontend connection then returns (the reference pairs exactly one
@@ -136,6 +259,25 @@ def serve_backend(
         return back
 
     back = build_backend()
+    if hub:
+        # many-frontend mode: every accepted connection joins the hub;
+        # the backend's push stream routes by doc interest and Replies
+        # by issuing connection. The daemon runs until killed.
+        hub_obj = _FrontendHub(back)
+        back.subscribe(hub_obj.dispatch)
+        try:
+            while True:
+                conn, _ = server.accept()
+                duplex = TcpDuplex(conn, is_client=False)
+                if duplex.closed:
+                    continue  # probe/failed handshake
+                hub_obj.attach(duplex)
+        finally:
+            back.close()
+            server.close()
+            if os.path.exists(sock_path):
+                os.remove(sock_path)
+        return
     idle_sink = False  # a discard sink is attached between frontends
     fence = ReplyFence()  # queryIds are epoch-tagged per frontend: a
     # previous frontend's in-flight handler cannot deliver its late
@@ -232,6 +374,12 @@ def main() -> None:
         "backend is reused across frontend cycles: swarm port and "
         "replicated state persist)",
     )
+    ap.add_argument(
+        "--hub", action="store_true",
+        help="serve MANY concurrent frontends against the one "
+        "backend (per-connection reply routing, per-doc interest "
+        "routing) — the many-writer daemon of bench config_writers",
+    )
     args = ap.parse_args()
     serve_backend(
         args.sock_path,
@@ -240,6 +388,7 @@ def main() -> None:
         once=not args.persist,
         tcp_listen=args.listen,
         tcp_connect=args.connect,
+        hub=args.hub,
     )
 
 

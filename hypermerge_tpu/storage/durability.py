@@ -16,8 +16,16 @@ outlives the process). HM_FSYNC picks the trade:
               window, not per append. An acked write is durable within
               one window (or at the next sqlite store flush, whose
               barrier syncs feeds FIRST — see below).
-  HM_FSYNC=2  fsync per append, before the .len sidecar write: an
-              acked append is durable when the call returns.
+  HM_FSYNC=2  the append is durable when the call returns.
+
+With the shared journal attached (HM_WAL=1, storage/wal.py — the
+file-backed default), BOTH durable tiers commit through it instead of
+fsyncing per-feed logs: tier 1's window fsyncs the JOURNAL once
+(O(1), not O(dirty feeds)); tier 2 rides the journal's leader/
+follower group commit, so concurrent writers on different docs share
+one fsync. The per-feed logs are fsynced only at checkpoint, off the
+ack path; recovery replays the journal prefix. HM_WAL=0 restores the
+legacy per-feed behavior below verbatim.
 
 Ordering invariants (the recoverable direction):
   - feed log fsync happens BEFORE the .len/index sidecar describes it
@@ -38,6 +46,7 @@ from __future__ import annotations
 
 import os
 import threading
+from contextlib import contextmanager
 from typing import Optional, Set
 
 from ..analysis.lockdep import make_lock
@@ -75,10 +84,101 @@ class DurabilityManager:
         self._dirty: Set = set()
         self._flusher = None
         self._closed = False
+        # the shared group-commit journal (storage/wal.py), attached
+        # by the RepoBackend after recovery consumed the previous
+        # session's journal; None = legacy per-feed durability
+        self.wal = None
+        # recovery replay suspends journaling: replayed blocks COME
+        # from the journal (single-threaded, scrub-only window)
+        self._wal_suspended = 0
+        # fired ONCE on the first journal-less feed write, when set
+        # (RepoBackend, HM_RECOVER=0 sessions): a preserved crash
+        # stamp must stop bounding recovery once writes land outside
+        # the preserved journal's ledger
+        self.journalless_write_cb = None
 
     @property
     def tier(self) -> int:
         return fsync_tier()
+
+    @property
+    def ack_durable(self) -> bool:
+        """HM_ACK_DURABLE=1: a local edit's ack (the LocalPatch echo)
+        waits for the WAL group commit at tier 1 — durable acks at
+        group-fsync cost. Tier 2 acks are already durable; tier 0 has
+        no durability to wait for."""
+        return os.environ.get("HM_ACK_DURABLE", "0") == "1"
+
+    def attach_wal(self, wal) -> None:
+        with self._lock:
+            self.wal = wal
+
+    @contextmanager
+    def suspended(self):
+        """Journaling off for the caller's block (recovery replay)."""
+        self._wal_suspended += 1
+        try:
+            yield
+        finally:
+            self._wal_suspended -= 1
+
+    def journal_append(self, path: str, index: int, data: bytes,
+                       storage) -> bool:
+        """Route one feed-block append through the shared journal.
+        True = the journal owns durability for this block (the caller
+        skips its per-feed fsync/mark); False = legacy path (no WAL,
+        tier 0 ledger-only, or a broken journal)."""
+        wal = self.wal
+        if wal is None or self._wal_suspended:
+            if wal is None and not self._wal_suspended:
+                cb = self.journalless_write_cb
+                if cb is not None:
+                    self.journalless_write_cb = None
+                    cb()
+            return False
+        name = os.path.basename(path)
+        tier = self.tier
+        if tier < 1:
+            # tier 0 never fsyncs — but the dirty-name ledger still
+            # bounds a kill -9 recovery's scan
+            wal.note_dirty(name, storage)
+            return False
+        end = wal.append(name, index, data, storage)
+        if end is None:
+            return False
+        if tier >= 2:
+            wal.commit(end)  # the leader/follower group fsync
+        else:
+            self.mark_dirty(wal)  # ONE journal fsync per window
+        return True
+
+    def commit_ack(self) -> None:
+        """The durable-ack barrier (HM_ACK_DURABLE=1, tier 1): block
+        until everything journaled so far — including the caller's
+        just-appended block — is on the platter. Riders share the
+        leader's ONE fsync (storage/wal.py group commit, HM_WAL_MS
+        gather window), so N concurrent writers' durable acks cost one
+        journal fsync per window, not N. Without a journal (HM_WAL=0)
+        this degrades to the legacy O(dirty feeds) barrier — and the
+        journal fsync only vouches for blocks it JOURNALED: an append
+        that fell back to the legacy path (transient journal write
+        error, broken journal) was mark_dirty'd instead, so any
+        non-journal dirty storage forces the legacy barrier too."""
+        wal = self.wal
+        if wal is not None and not self._wal_suspended:
+            try:
+                wal.sync()
+            except OSError:
+                # journal closed/broken without covering the append:
+                # the bytes live in the feed logs — fsync those
+                self.barrier()
+                return
+            with self._lock:
+                legacy = any(s is not wal for s in self._dirty)
+            if legacy:
+                self.barrier()
+        else:
+            self.barrier()
 
     def mark_dirty(self, storage) -> None:
         if self.tier < 1:
@@ -173,6 +273,7 @@ class DurabilityManager:
         with self._lock:
             dirty = list(self._dirty)
             self._dirty.clear()
+            wal = self.wal
         clean = True
         for s in dirty:
             try:
@@ -180,4 +281,8 @@ class DurabilityManager:
             except OSError as e:
                 log("storage:durability", f"close sync failed: {e}")
                 clean = False
+        if wal is not None:
+            # final checkpoint: per-feed logs durable, journal reset —
+            # a clean close leaves nothing to replay
+            clean = wal.close() and clean
         return clean

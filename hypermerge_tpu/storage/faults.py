@@ -416,6 +416,15 @@ class _Active:
 
 _active: Optional[_Active] = None
 _active_lock = make_lock("store.fault.active")
+# bumped on every install AND uninstall: long-lived cached write
+# handles (FileFeedStorage's hot-append fds) compare this to decide
+# whether to re-open through the seam — a handle opened before a
+# harness activated would otherwise bypass injection/recording
+_gen = 0
+
+
+def harness_gen() -> int:
+    return _gen
 
 
 @contextlib.contextmanager
@@ -425,16 +434,18 @@ def activate(
 ):
     """Install a fault plan and/or crash recorder on the io_* seam for
     the duration of the block. One harness at a time (tests)."""
-    global _active
+    global _active, _gen
     with _active_lock:
         if _active is not None:
             raise RuntimeError("a disk-fault harness is already active")
         _active = _Active(plan, recorder)
+        _gen += 1
     try:
         yield _active
     finally:
         with _active_lock:
             _active = None
+            _gen += 1
 
 
 def active_recorder() -> Optional[CrashRecorder]:

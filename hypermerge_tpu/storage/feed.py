@@ -28,7 +28,7 @@ from ..utils.debug import log
 from ..utils.ids import DiscoveryId, get_or_create
 from ..utils.queue import Queue
 from .durability import fsync_tier
-from .faults import io_fsync, io_open, io_remove
+from .faults import harness_gen, io_fsync, io_open, io_remove
 
 
 class MemoryFeedStorage:
@@ -89,6 +89,49 @@ class FileFeedStorage:
         # cold open constructs thousands of these and metadata syscalls
         # are a measurable slice of its serial host time
         self._init_checked = False
+        # cached write handles (log + .len sidecar): an acked edit's
+        # append is the repo's hottest path, and re-opening both files
+        # per append was ~0.5ms of serialized syscall+setup cost under
+        # the per-doc emission domain (bench config_writers). Handles
+        # open lazily on the first append — read-only consumers (the
+        # bulk cold open's thousands of storages) never pay an fd —
+        # and drop on close/destroy/repair/truncate. The appender
+        # (under its doc's emission domain + feed lock) and the WAL
+        # checkpoint thread's sync() share these fds: _io serializes
+        # every use/drop (analysis/guards.py FileFeedStorage).
+        self._io = make_rlock("store.feed_io")
+        self._wfh = None
+        self._len_fh = None
+        self._fh_gen = -1  # faults.harness_gen() the handles saw
+
+    def _check_gen(self) -> None:
+        # a fault harness came or went since the handles were opened:
+        # they must re-open through the io_* seam, or injected faults
+        # and crash recording would bypass the hot path entirely.
+        # REQUIRES store.feed_io (analysis/guards.py).
+        gen = harness_gen()
+        if gen != self._fh_gen:
+            self._drop_write_handles()
+            self._fh_gen = gen
+
+    def _write_handle(self):
+        # REQUIRES store.feed_io (analysis/guards.py)
+        self._check_gen()
+        if self._wfh is None or self._wfh.closed:
+            mode = "r+b" if os.path.exists(self.path) else "w+b"
+            self._wfh = io_open(self.path, mode)
+        return self._wfh
+
+    def _drop_write_handles(self) -> None:
+        # REQUIRES store.feed_io (analysis/guards.py)
+        for fh in (self._wfh, self._len_fh):
+            if fh is not None:
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+        self._wfh = None
+        self._len_fh = None
 
     def _check_init(self) -> None:
         if self._init_checked:
@@ -103,8 +146,16 @@ class FileFeedStorage:
         return self.path + ".len"
 
     def _write_len(self) -> None:
-        with io_open(self._len_path(), "wb") as fh:
-            fh.write(self._LEN.pack(self._count, self._end))
+        # REQUIRES store.feed_io (analysis/guards.py)
+        self._check_gen()
+        fh = self._len_fh
+        if fh is None or fh.closed:
+            # w+b then in-place rewrites: the record is fixed-size, so
+            # no truncate is ever needed after the first open
+            self._len_fh = fh = io_open(self._len_path(), "w+b")
+        fh.seek(0)
+        fh.write(self._LEN.pack(self._count, self._end))
+        fh.flush()
 
     def _try_count_shortcut(self) -> bool:
         """Trust the .len sidecar iff its end offset equals the log's
@@ -156,8 +207,12 @@ class FileFeedStorage:
         self._count = len(self._offsets)
 
     def append(self, data: bytes) -> None:
+        with self._io:
+            self._append_io_locked(data)
+
+    def _append_io_locked(self, data: bytes) -> None:
+        # REQUIRES store.feed_io (analysis/guards.py)
         self._ensure_scan()
-        mode = "r+b" if os.path.exists(self.path) else "w+b"
         tier = fsync_tier()
         # exception safety under mid-write ENOSPC/EIO: the in-memory
         # _offsets/_end/_count only advance AFTER every log byte landed
@@ -165,16 +220,34 @@ class FileFeedStorage:
         # the pre-append end, so the next append seeks there, overwrites
         # the torn tail, and truncates the stale bytes. The (possibly
         # torn) on-disk tail is exactly what the scan already heals.
-        with io_open(self.path, mode) as fh:
+        # A raise also drops the cached handle: its buffered state is
+        # no longer trustworthy.
+        try:
+            fh = self._write_handle()
             fh.seek(self._end)  # overwrite any torn tail...
             fh.write(self._HDR.pack(len(data)))
             fh.write(data)
             fh.truncate()  # ...and drop stale bytes beyond it, so a later
             # scan can't misparse leftovers as a phantom block
             fh.flush()
-            if tier >= 2:
-                # log durable BEFORE the .len sidecar describes it
+            # shared journal (storage/wal.py): at HM_FSYNC>=1 the
+            # block's durability is ONE sequential journal append +
+            # the group-commit fsync — the log itself stays page-cache
+            # only until checkpoint. A raise here (journal fsync
+            # error) unwinds exactly like a torn write: memory never
+            # advances, the on-disk tail heals on the next append.
+            journaled = False
+            if self._durability is not None:
+                journaled = self._durability.journal_append(
+                    self.path, len(self._offsets), data, self
+                )
+            if tier >= 2 and not journaled:
+                # legacy: log durable BEFORE the .len sidecar
+                # describes it
                 io_fsync(fh)
+        except BaseException:
+            self._drop_write_handles()
+            raise
         self._offsets.append(self._end + self._HDR.size)
         self._sizes.append(len(data))
         self._end += self._HDR.size + len(data)
@@ -186,24 +259,38 @@ class FileFeedStorage:
             # just costs the next open a rescan) — never fail the
             # acked append over it
             log("storage:feed", f".len write failed {self.path}: {e}")
-        if tier == 1 and self._durability is not None:
+        if tier == 1 and not journaled and self._durability is not None:
             self._durability.mark_dirty(self)
 
     def sync(self) -> None:
         """Make the log (and its .len sidecar) durable: the tier-1
         group-fsync target and the pre-sqlite barrier. Log first, .len
-        second — the sidecar must never describe unfsynced bytes."""
+        second — the sidecar must never describe unfsynced bytes.
+        Serializes against the appender under _io: the WAL checkpoint
+        thread calls this on a storage whose cached handles a writer
+        may be mid-append on."""
         if not os.path.exists(self.path):
             return
-        with io_open(self.path, "r+b") as fh:
-            io_fsync(fh)
-        if self._count is not None:
-            try:
-                self._write_len()
-                with io_open(self._len_path(), "r+b") as fh:
+        with self._io:
+            self._check_gen()
+            fh = self._wfh
+            if fh is not None and not fh.closed:
+                # the cached append handle: every append flushed
+                # before _io released, so an fd-level fsync is safe
+                io_fsync(fh)
+            else:
+                with io_open(self.path, "r+b") as fh:
                     io_fsync(fh)
-            except OSError as e:
-                log("storage:feed", f".len sync failed {self.path}: {e}")
+            if self._count is not None:
+                try:
+                    self._write_len()
+                    with io_open(self._len_path(), "r+b") as fh:
+                        io_fsync(fh)
+                except OSError as e:
+                    log(
+                        "storage:feed",
+                        f".len sync failed {self.path}: {e}",
+                    )
 
     def repair(self, write: bool = True) -> Dict[str, int]:
         """Crash recovery: scan the log, physically truncate any torn
@@ -213,47 +300,51 @@ class FileFeedStorage:
         makes the on-disk state clean NOW so audits, byte accounting,
         and read-only consumers see no leftovers.)"""
         out = {"blocks": 0, "bytes_truncated": 0}
-        if not os.path.exists(self.path):
-            return out
-        # force a fresh scan (ignore any .len shortcut state)
-        self._scanned = False
-        self._count = None
-        self._init_checked = True
-        self._ensure_scan()
-        out["blocks"] = self._count or 0
-        size = os.path.getsize(self.path)
-        if size > self._end:
-            out["bytes_truncated"] = size - self._end
+        with self._io:
+            self._drop_write_handles()  # repair rewrites out-of-band
+            if not os.path.exists(self.path):
+                return out
+            # force a fresh scan (ignore any .len shortcut state)
+            self._scanned = False
+            self._count = None
+            self._init_checked = True
+            self._ensure_scan()
+            out["blocks"] = self._count or 0
+            size = os.path.getsize(self.path)
+            if size > self._end:
+                out["bytes_truncated"] = size - self._end
+                if write:
+                    with io_open(self.path, "r+b") as fh:
+                        fh.truncate(self._end)
             if write:
-                with io_open(self.path, "r+b") as fh:
-                    fh.truncate(self._end)
-        if write:
-            try:
-                self._write_len()
-            except OSError:
-                pass
+                try:
+                    self._write_len()
+                except OSError:
+                    pass
         return out
 
     def truncate_to(self, count: int) -> int:
         """Drop blocks beyond `count` (scrub's recovery for a READ-ONLY
         feed whose unsigned tail cannot be trusted — the blocks
         re-replicate from peers). Returns the number dropped."""
-        self._ensure_scan()
-        if count >= len(self._offsets):
-            return 0
-        dropped = len(self._offsets) - count
-        self._end = (
-            self._offsets[count] - self._HDR.size if count else 0
-        )
-        del self._offsets[count:]
-        del self._sizes[count:]
-        self._count = count
-        with io_open(self.path, "r+b") as fh:
-            fh.truncate(self._end)
-        try:
-            self._write_len()
-        except OSError:
-            pass
+        with self._io:
+            self._ensure_scan()
+            if count >= len(self._offsets):
+                return 0
+            dropped = len(self._offsets) - count
+            self._end = (
+                self._offsets[count] - self._HDR.size if count else 0
+            )
+            del self._offsets[count:]
+            del self._sizes[count:]
+            self._count = count
+            self._drop_write_handles()
+            with io_open(self.path, "r+b") as fh:
+                fh.truncate(self._end)
+            try:
+                self._write_len()
+            except OSError:
+                pass
         return dropped
 
     def get(self, index: int) -> bytes:
@@ -268,17 +359,20 @@ class FileFeedStorage:
 
     def destroy(self) -> None:
         """Remove the block log (and its .len index) from disk."""
-        for p in (self.path, self._len_path()):
-            if os.path.exists(p):
-                io_remove(p)
-        self._offsets = []
-        self._sizes = []
-        self._end = 0
-        self._count = 0
-        self._scanned = True
+        with self._io:
+            self._drop_write_handles()
+            for p in (self.path, self._len_path()):
+                if os.path.exists(p):
+                    io_remove(p)
+            self._offsets = []
+            self._sizes = []
+            self._end = 0
+            self._count = 0
+            self._scanned = True
 
     def close(self) -> None:
-        pass
+        with self._io:
+            self._drop_write_handles()
 
 
 StorageFn = Callable[[str], object]  # name -> storage backend

@@ -144,6 +144,20 @@ def _recover_repo(back, repair: bool) -> Dict:
     per_feed: Dict[str, Dict] = {}
     report["per_feed"] = per_feed
 
+    # -- journal replay FIRST (storage/wal.py): acked blocks a power
+    # cut dropped from the (unfsynced-at-ack) per-feed logs come back
+    # from the fsynced journal, so the torn-tail/sig/clock passes
+    # below see the replayed reality. The journal's session stamp +
+    # dirty-name ledger also BOUND the scan: a matching durable-tier
+    # journal proves which feeds the crashed session could have
+    # touched, and every other sidecar is skipped unopened (the
+    # 100k-feed recovery constant).
+    from . import wal as walmod
+
+    wal_report = walmod.recover(back, repair)
+    report["wal"] = wal_report
+    bounded = bool(wal_report.get("bounded"))
+
     # -- slab: loading IS the repair-forward (torn segments ignored,
     # index rebuilt/extended from segment headers) ---------------------
     slab = getattr(back, "_col_slab", None)
@@ -158,6 +172,10 @@ def _recover_repo(back, repair: bool) -> Dict:
     feeds_root = os.path.join(back.path, "feeds")
     names = set(back.feed_info.all_public_ids())
     names |= feed_names_on_disk(feeds_root)
+    if bounded:
+        dirty = set(wal_report.get("dirty", ()))
+        report["feeds_skipped"] = len(names - dirty)
+        names &= dirty
     blocks_by_feed: Dict[str, int] = {}
     for name in sorted(names):
         entry: Dict = {}
@@ -239,8 +257,12 @@ def _recover_repo(back, repair: bool) -> Dict:
     # monotonic-safe: replication re-fills them, so they stay.)
     for doc_id in back.clocks.all_doc_ids(back.id):
         clock = back.clocks.get(back.id, doc_id)
+        # bounded runs: an actor OUTSIDE the scan set is session-clean
+        # by the journal's ledger — its clock row stands. Full scans
+        # keep the strict rule: no feed on disk means clamp to zero.
         clamped = {
-            a: min(s, blocks_by_feed.get(a, 0)) for a, s in clock.items()
+            a: min(s, blocks_by_feed.get(a, s if bounded else 0))
+            for a, s in clock.items()
         }
         if clamped != clock:
             n = sum(
@@ -284,6 +306,28 @@ def last_report(path: str) -> Optional[Dict]:
             return json.load(fh)
     except (OSError, ValueError):
         return None
+
+
+def wal_status(report: Optional[Dict], actors) -> str:
+    """Per-doc journal verdict for tools/ls.py, from the persisted
+    scrub report's `wal` section:
+
+      replayed      the last recovery re-appended journaled blocks
+                    into one of this doc's feeds (a power cut had
+                    dropped unfsynced log pages)
+      checkpointed  the crashed session touched a feed of this doc,
+                    but its blocks were already durable in the logs
+                    (nothing to replay)
+      clean         untouched by the crashed session (or no journal
+                    ran)
+    """
+    wal = (report or {}).get("wal") or {}
+    actors = set(actors)
+    if actors & set(wal.get("replayed_feeds", ())):
+        return "replayed"
+    if actors & set(wal.get("dirty", ())):
+        return "checkpointed"
+    return "clean"
 
 
 def doc_status(back, doc_id: str, report: Optional[Dict] = None) -> str:

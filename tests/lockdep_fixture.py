@@ -1,16 +1,21 @@
-"""Shared lockdep-on-for-this-module fixture (test_chaos, test_live).
+"""Shared lockdep-on-for-this-module fixture (test_chaos, test_live,
+test_write_plane).
 
 The fault harness and the live twin suites double as RACE DRIVERS:
 running them with HM_LOCKDEP=1 makes every lock they churn through an
-instrumented one, and the module teardown asserts the observed global
-lock-order graph is clean — no potential deadlock cycle, no declared-
-hierarchy inversion, no leaf violation — even though no deadlock fired.
+instrumented one — the per-doc `doc.emit` emission domains and the
+`store.wal` journal lock included — and the module teardown asserts
+the observed global lock-order graph is clean: no potential deadlock
+cycle, no declared-hierarchy inversion, no leaf violation, and no
+same-class `doc.emit` nesting (the no-cross-doc-lock-across-push
+invariant of the write plane), even though no deadlock fired.
 
-`blocking` violations are excluded from the assertion: the live path's
-feed-append + clock-row commit inside the engine lock is the KNOWN,
-ROADMAP-documented emission-serialization cost (the per-doc emission
-lock split is the successor work); lockdep still records them so
-`report()` shows the debt.
+Since the write-plane split (PR 14) `blocking` violations are asserted
+too: the only no-block class left is `live.engine` (tick/dirty-set
+coordination), and ANY blocking call under it is a regression of the
+`lock.held_blocking_ms.live_engine == 0` gate. Blocking under a doc's
+own emission domain is by-design (a durable ack stalls exactly one
+doc) and is not a violation.
 """
 
 import os
@@ -39,7 +44,6 @@ def lockdep_suite():
         else:
             os.environ["HM_LOCKDEP"] = was_env
         lockdep.assert_clean(
-            allow_kinds=("blocking",),
             msg="the suite's lock churn surfaced lockdep findings:",
         )
 

@@ -1,20 +1,21 @@
 """Shared racedep-on-for-this-module fixture (test_live,
-test_serve_races) — the lockset sibling of tests/lockdep_fixture.py.
+test_serve_races, test_write_plane) — the lockset sibling of
+tests/lockdep_fixture.py.
 
 HM_RACEDEP=1 wraps every non-`unguarded` attribute of the guard
 manifest (hypermerge_tpu/analysis/guards.py) in an Eraser-style
 lockset descriptor: each access intersects the per-(object, attribute)
 candidate lockset with the accessing thread's held locks, so a shared
 field that no lock consistently guards is REPORTED without the race
-ever needing to fire. Running the live twin + serve race suites fully
-instrumented turns their churn into a guard-map verifier; the module
-teardown asserts a clean lockset report.
+ever needing to fire. The write-plane split relocated the engine-lock
+guard rows onto the per-doc classes (`_LiveDoc` under `doc.emit`,
+`WriteAheadLog` under `store.wal`) — running the live twin + serve
+race suites fully instrumented verifies the relocated map against
+real churn; the module teardown asserts a clean lockset report.
 
-`blocking` violations are excluded for the same reason as the lockdep
-fixture: the live path's feed-append/clock-commit inside the engine
-lock is the KNOWN write-plane debt (now measured as
-`lock.held_blocking_ms.live_engine`; the per-doc emission split is
-gated on it reading zero).
+`blocking` violations are asserted too (see lockdep_fixture.py): the
+only no-block class left is `live.engine`, and any blocking call
+under it regresses the zero-lock-debt gate.
 """
 
 import os
@@ -41,7 +42,6 @@ def racedep_suite():
             os.environ["HM_RACEDEP"] = was_env
         try:
             lockdep.assert_clean(
-                allow_kinds=("blocking",),
                 msg="the suite's churn surfaced lockset findings:",
             )
         finally:

@@ -472,3 +472,155 @@ def test_persist_mode_queries_survive_frontend_swaps(tmp_path):
         assert got2[0] is not None
         close()
         time.sleep(0.2)
+
+
+# ---------------------------------------------------------------------------
+# hub mode: MANY concurrent frontends, one daemon (the write-plane
+# process topology bench config_writers measures)
+
+
+def test_hub_many_writers_disjoint_docs(tmp_path):
+    """4 frontend processes' worth of connections (in-process here, 4
+    sockets) each create + edit their OWN doc against one --hub daemon:
+    every writer's acked edits land, and interest routing keeps each
+    frontend's state correct while all four streams interleave on the
+    daemon."""
+    proc, sock, _ = _start_backend(str(tmp_path / "repo"), "--hub")
+    try:
+        from hypermerge_tpu.net.ipc import connect_frontend
+
+        fronts = [connect_frontend(sock) for _ in range(4)]
+        urls, handles = [], []
+        for w, (front, _close) in enumerate(fronts):
+            url = front.create({"w": w, "edits": []})
+            urls.append(url)
+            h = front.open(url)
+            _wait(lambda h=h: _val(h) is not None)
+            handles.append(h)
+        n_edits = 15
+
+        def churn(w):
+            front = fronts[w][0]
+            for i in range(n_edits):
+                front.change(
+                    urls[w], lambda d, i=i: d["edits"].append(i)
+                )
+
+        ts = [
+            threading.Thread(target=churn, args=(w,)) for w in range(4)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        for w, h in enumerate(handles):
+            _wait(
+                lambda h=h: len((_val(h) or {}).get("edits", []))
+                == n_edits
+            )
+            v = _val(h)
+            # the writer's own doc: its edits, in its order, and the
+            # identity field no other writer's traffic can have touched
+            assert v["w"] == w
+            assert list(v["edits"]) == list(range(n_edits))
+        for _front, close in fronts:
+            close()
+    finally:
+        _stop(proc, sock)
+
+
+def test_hub_reply_routing_per_connection(tmp_path):
+    """Every hub frontend restarts its queryId counter at the same
+    small integers; concurrent Materialize/Metadata queries from two
+    connections must each resolve on their OWN connection (the
+    per-connection tag the hub adds inbound and strips outbound)."""
+    proc, sock, _ = _start_backend(str(tmp_path / "repo"), "--hub")
+    try:
+        from hypermerge_tpu.net.ipc import connect_frontend
+
+        fa, close_a = connect_frontend(sock)
+        fb, close_b = connect_frontend(sock)
+        ua = fa.create({"who": "a"})
+        ub = fb.create({"who": "b"})
+        ha, hb = fa.open(ua), fb.open(ub)
+        _wait(lambda: _val(ha) is not None and _val(hb) is not None)
+        got_a, got_b = [], []
+        for _ in range(5):
+            fa.materialize(ua, 1, got_a.append)
+            fb.materialize(ub, 1, got_b.append)
+        _wait(lambda: len(got_a) == 5 and len(got_b) == 5, timeout=30)
+        assert all(g and g.get("who") == "a" for g in got_a), got_a
+        assert all(g and g.get("who") == "b" for g in got_b), got_b
+        close_a()
+        close_b()
+    finally:
+        _stop(proc, sock)
+
+
+def test_hub_shared_doc_watcher_sees_writer_patches(tmp_path):
+    """A hub frontend WATCHING a doc another connection writes receives
+    every patch (interest routing is per doc, not per creator). Note
+    the supported topology: one WRITING frontend per doc — the backend
+    grants one writable actor per doc, so a second connection editing
+    the same doc would collide on its seq counter (concurrent shared-
+    doc writers go through separate daemons + replication, as in the
+    reference design); hub mode's concurrency win is DISJOINT docs."""
+    proc, sock, _ = _start_backend(str(tmp_path / "repo"), "--hub")
+    try:
+        from hypermerge_tpu.net.ipc import connect_frontend
+
+        fa, close_a = connect_frontend(sock)
+        fb, close_b = connect_frontend(sock)
+        url = fa.create({"edits": []})
+        ha = fa.open(url)
+        _wait(lambda: "edits" in (_val(ha) or {}))
+        hb = fb.open(url)
+        # fb may open before fa's init echo reaches the backend — its
+        # Ready snapshot is legitimately blank then; the init arrives
+        # as a routed Patch (fb is interested now)
+        _wait(lambda: "edits" in (_val(hb) or {}))
+        for i in range(5):
+            fa.change(url, lambda d, i=i: d["edits"].append(i))
+        for h in (ha, hb):  # the watcher converges with the writer
+            _wait(
+                lambda h=h: list(
+                    (_val(h) or {}).get("edits", [])
+                ) == list(range(5))
+            )
+        close_a()
+        close_b()
+    finally:
+        _stop(proc, sock)
+
+
+def test_hub_interest_table_drops_empty_entries():
+    """The hub's doc-interest table tracks LIVE interest: Close and
+    connection detach must delete a doc's entry once its last watcher
+    leaves (a long-lived daemon would otherwise grow one entry per
+    doc id ever named, forever)."""
+    from types import SimpleNamespace
+
+    from hypermerge_tpu.net.ipc import _FrontendHub
+
+    class _FakeDuplex:
+        def on_close(self, cb):
+            self.close_cb = cb
+
+        def on_message(self, cb):
+            self.msg_cb = cb
+
+    hub = _FrontendHub(SimpleNamespace(receive=lambda _m: None))
+    d1, d2 = _FakeDuplex(), _FakeDuplex()
+    hub.attach(d1)
+    hub.attach(d2)
+    d1.msg_cb({"type": "Open", "id": "docX"})
+    d2.msg_cb({"type": "Open", "id": "docX"})
+    d1.msg_cb({"type": "Open", "id": "docY"})
+    assert set(hub._interest) == {"docX", "docY"}
+    d1.msg_cb({"type": "Close", "id": "docY"})  # last watcher closes
+    assert set(hub._interest) == {"docX"}
+    d1.close_cb()  # detach: docX keeps d2's interest
+    assert set(hub._interest) == {"docX"}
+    d2.close_cb()  # last watcher detaches: table empties
+    assert hub._interest == {}
+    assert hub._conns == {}

@@ -506,3 +506,99 @@ def test_serve_ipc_read_queries(tmp_path):
     finally:
         serve.kill()
         serve.wait(timeout=10)
+
+
+def test_scrub_cli_surfaces_journal_state(tmp_path, monkeypatch):
+    """The scrub CLI reports the group-commit journal: record/dirty
+    counts, replay verdicts, and whether the generation stamp bounded
+    the scan — and the dry run preserves the stamp byte-for-byte so
+    the later real pass is STILL bounded."""
+    monkeypatch.setenv("HM_FSYNC", "1")
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"n": 0})
+    repo.change(url, lambda d: d.__setitem__("n", 7))
+    if repo.back.live is not None:
+        repo.back.live.flush_now()
+    repo.back._stores.flush_now()
+    repo.back.durability.flush_now()
+    del repo  # crash: marker + journal stay behind
+
+    out = _run(["tools/scrub.py", path, "--dry-run", "--json"])
+    assert out.returncode == 0, out.stderr
+    report = json.loads(out.stdout.strip().splitlines()[-1])
+    wal = report["wal"]
+    assert wal["present"] == 1 and wal["session_match"] == 1, wal
+    assert wal["bounded"] == 1 and wal["dirty_feeds"] >= 1, wal
+
+    out = _run(["tools/scrub.py", path])
+    assert out.returncode == 0, out.stderr
+    assert "journal:" in out.stdout
+    assert "scan bounded to the session ledger" in out.stdout
+
+
+def test_ls_surfaces_wal_column(tmp_path, monkeypatch):
+    """ls.py's wal= column: a crashed session's docs show their
+    journal verdict (checkpointed/replayed); docs untouched by the
+    crashed session show clean."""
+    monkeypatch.setenv("HM_FSYNC", "1")
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url_touched = repo.create({"n": 0})
+    url_clean = repo.create({"n": 1})
+    if repo.back.live is not None:
+        repo.back.live.flush_now()
+    repo.close()  # clean
+
+    repo2 = Repo(path=path)
+    repo2.change(url_touched, lambda d: d.__setitem__("n", 42))
+    if repo2.back.live is not None:
+        repo2.back.live.flush_now()
+    repo2.back._stores.flush_now()
+    repo2.back.durability.flush_now()
+    del repo2  # crash
+
+    out = _run(["tools/ls.py", path])
+    assert out.returncode == 0, out.stderr
+    lines = {
+        line.split()[0]: line
+        for line in out.stdout.splitlines()
+        if line.startswith("hypermerge:/")
+    }
+    assert "wal=checkpointed" in lines[url_touched] or (
+        "wal=replayed" in lines[url_touched]
+    ), lines[url_touched]
+    assert "wal=clean" in lines[url_clean], lines[url_clean]
+
+
+def test_top_groups_wal_counters(tmp_path):
+    """storage.wal.* counters render as their own [wal] rate group."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hm_top", os.path.join(REPO_ROOT, "tools", "top.py")
+    )
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    cur = {
+        "counters": {
+            "storage.wal.appends": 100,
+            "storage.wal.fsyncs": 4,
+            "storage.wal.bytes": 12800,
+            "storage.fsyncs": 9,
+        }
+    }
+    prev = {
+        "counters": {
+            "storage.wal.appends": 50,
+            "storage.wal.fsyncs": 2,
+            "storage.wal.bytes": 6400,
+            "storage.fsyncs": 9,
+        }
+    }
+    table = top.format_rows(prev, cur, 1.0)
+    assert "[wal]" in table
+    assert "storage.wal.appends" in table
+    assert "(+50.0/s)" in table
+    # the non-journal storage counter stays in [storage]
+    assert "[storage]" in table

@@ -1,0 +1,211 @@
+"""The many-writer write plane (backend/emission.py + storage/wal.py).
+
+Pins the PR-14 split invariants with the machine checkers ON:
+
+- the two-writer seeded race: disjoint docs edited concurrently from
+  separate threads, fully instrumented (HM_LOCKDEP=1 + HM_RACEDEP=1).
+  The module teardown asserts a clean graph/lockset report — in
+  particular NO same-class `doc.emit` nesting (a thread never holds
+  two docs' emission domains, and never any OTHER doc's domain across
+  a feed append or push) and NO blocking call under `live.engine`
+  (the zero-lock-debt gate as a hard failure, not a counter);
+- cross-doc re-entry defers: a frontend callback dispatched
+  synchronously from one doc's push that edits ANOTHER doc must not
+  drag the first domain into the second doc's handler — the work
+  replays on the deferred-emission worker;
+- emission-domain bookkeeping units (entered_other / held_by_me).
+"""
+
+import threading
+
+from hypermerge_tpu.backend import emission
+
+from helpers import wait_until
+from lockdep_fixture import lockdep_suite
+from racedep_fixture import racedep_suite
+
+_lockdep = lockdep_suite()
+_racedep = racedep_suite()
+
+
+# ---------------------------------------------------------------------------
+# emission-domain units
+
+
+def test_domain_entry_bookkeeping():
+    a = emission.EmissionDomain("docA")
+    b = emission.EmissionDomain("docB")
+    assert not a.held_by_me()
+    with a:
+        assert a.held_by_me()
+        assert emission.entered_ids() == ["docA"]
+        # same-doc re-entry is NOT "other": the re-entrant domain
+        # recurses (an in-process frontend's on_patch sending the next
+        # change of the SAME doc)
+        assert not emission.entered_other("docA")
+        # a cross-doc call from inside the emission MUST defer
+        assert emission.entered_other("docB")
+        with a:  # re-entrant
+            assert emission.entered_ids() == ["docA", "docA"]
+        assert emission.entered_ids() == ["docA"]
+    assert not a.held_by_me()
+    assert not emission.entered_other("docB")
+    del b
+
+
+def test_defer_runs_off_thread_in_order():
+    got = []
+    ev = threading.Event()
+    for i in range(8):
+        emission.defer(lambda i=i: got.append(i))
+    emission.defer(ev.set)
+    assert ev.wait(10)
+    assert got == list(range(8))  # FIFO, one worker
+    assert threading.current_thread().name != "hm-emit-defer"
+
+
+# ---------------------------------------------------------------------------
+# the two-writer seeded race (instrumented; teardown asserts clean)
+
+
+def test_two_writers_disjoint_docs_instrumented():
+    """Two threads, two docs, interleaved ack-paced edits with the
+    live engine on: every edit lands exactly once, and the module's
+    lockdep/racedep teardown proves no cross-doc domain nesting and
+    no blocking under the engine lock happened anywhere in the run."""
+    from hypermerge_tpu.repo import Repo
+
+    repo = Repo(memory=True)
+    try:
+        urls = [repo.create({"edits": []}) for _ in range(2)]
+        n_edits = 30
+        barrier = threading.Barrier(2)
+
+        def writer(w):
+            barrier.wait()  # maximize interleaving (seeded start)
+            for i in range(n_edits):
+                repo.change(
+                    urls[w], lambda d, i=i: d["edits"].append(i)
+                )
+
+        ts = [
+            threading.Thread(target=writer, args=(w,)) for w in (0, 1)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        if repo.back.live is not None:
+            repo.back.live.flush_now()
+        for url in urls:
+            wait_until(
+                lambda url=url: list(
+                    (repo.doc(url) or {}).get("edits", [])
+                )
+                == list(range(n_edits))
+            )
+    finally:
+        repo.close()
+
+
+def test_cross_doc_reentry_defers_not_nests():
+    """A subscriber editing doc B from inside doc A's patch dispatch
+    (the emitting thread holds A's domain): the edit must land via the
+    deferred-emission worker — both docs converge, and the teardown
+    asserts no doc.emit -> doc.emit same-class edge was ever taken."""
+    from hypermerge_tpu.repo import Repo
+
+    repo = Repo(memory=True)
+    try:
+        url_a = repo.create({"n": 0})
+        url_b = repo.create({"mirror": -1})
+        fired = []
+
+        def mirror(state, _index):
+            n = state.get("n", 0)
+            if n >= 1 and n not in fired:
+                fired.append(n)
+                # cross-doc re-entry: this thread may be mid-emission
+                # for doc A; doc B's handler must defer, not nest
+                repo.change(
+                    url_b, lambda d, n=n: d.__setitem__("mirror", n)
+                )
+
+        h = repo.watch(url_a, mirror)
+        for i in range(1, 4):
+            repo.change(url_a, lambda d, i=i: d.__setitem__("n", i))
+        wait_until(
+            lambda: (repo.doc(url_b) or {}).get("mirror") == 3
+        )
+        h.close()
+    finally:
+        repo.close()
+
+
+def test_open_from_patch_callback_defers_ready():
+    """A subscriber that OPENS another doc from inside a patch
+    dispatch (the emitting thread holds doc A's domain): the Open's
+    Ready emission must defer instead of nesting doc B's domain under
+    A's — the instrumented module teardown turns any same-class
+    `doc.emit` nesting into a hard failure, and two threads
+    cross-opening would be an ABBA deadlock."""
+    from hypermerge_tpu.repo import Repo
+
+    repo = Repo(memory=True)
+    try:
+        url_a = repo.create({"n": 0})
+        url_b = repo.create({"other": 1})
+        repo.close_doc(url_b)  # B's Ready will be re-sent on re-open
+        opened = []
+
+        def open_other(state, _index):
+            if state.get("n", 0) >= 1 and not opened:
+                opened.append(True)
+                # cross-doc re-entry: Open -> _send_ready(B) on a
+                # thread that may hold A's domain
+                repo.watch(
+                    url_b,
+                    lambda st, _i: opened.append(dict(st or {})),
+                )
+
+        h = repo.watch(url_a, open_other)
+        repo.change(url_a, lambda d: d.__setitem__("n", 1))
+        wait_until(
+            lambda: any(
+                isinstance(o, dict) and o.get("other") == 1
+                for o in opened
+            )
+        )
+        h.close()
+    finally:
+        repo.close()
+
+
+def test_send_ready_defers_under_foreign_domain(monkeypatch):
+    """Deterministic pin of the _send_ready escape hatch: invoked on
+    a thread holding ANOTHER doc's emission domain (the Open-inside-
+    patch-dispatch shape), the Ready must park on the deferred-
+    emission worker instead of nesting doc B's domain under doc A's
+    (same-class order violation; ABBA with two cross-opening
+    threads)."""
+    from hypermerge_tpu.repo import Repo
+    from hypermerge_tpu.utils.ids import url_to_id
+
+    repo = Repo(memory=True)
+    try:
+        url_a = repo.create({"n": 0})
+        url_b = repo.create({"other": 1})
+        back = repo.back
+        doc_a = back.docs[url_to_id(url_a)]
+        doc_b = back.docs[url_to_id(url_b)]
+        deferred = []
+        monkeypatch.setattr(
+            emission, "defer", lambda fn: deferred.append(fn)
+        )
+        with doc_a.emission:
+            back._send_ready(doc_b)
+            assert deferred, "Ready nested B's domain under A's"
+            assert not doc_b.emission.held_by_me()
+        deferred[0]()  # the worker's replay: clean thread, no domains
+    finally:
+        repo.close()

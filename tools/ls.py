@@ -24,6 +24,14 @@ sidecar resets, seals), `truncated-N-blocks` (recovery dropped N
 unverifiable blocks; they re-replicate from peers), or
 `unsigned_tail` (blocks currently beyond the last signature record).
 
+The `wal=` column is the group-commit journal's per-doc verdict from
+the persisted scrub report (storage/scrub.py wal_status): `replayed`
+(the last recovery re-appended journaled blocks into this doc's feeds
+— a power cut had dropped unfsynced log pages), `checkpointed` (the
+crashed session touched this doc but its blocks were already durable
+in the logs), or `clean` (untouched by the crashed session, or no
+journal ran).
+
 --audit additionally re-hashes each feed against its signed merkle
 records (storage/integrity.py) and flags tampering. A writable feed
 whose process crashed between an append and the periodic signature
@@ -51,6 +59,7 @@ from hypermerge_tpu.storage.integrity import (  # noqa: E402
 from hypermerge_tpu.storage.scrub import (  # noqa: E402
     doc_status,
     last_report,
+    wal_status,
 )
 from hypermerge_tpu.utils.ids import to_doc_url  # noqa: E402
 
@@ -152,7 +161,8 @@ def main() -> None:
             f"{to_doc_url(doc_id)}  actors={len(cursor)} "
             f"changes={total_changes} bytes={nbytes} "
             f"residency={residency(doc_id)} "
-            f"scrub={doc_status(back, doc_id, report)}"
+            f"scrub={doc_status(back, doc_id, report)} "
+            f"wal={wal_status(report, cursor)}"
         )
         if args.audit:
             # three-way status: OK / UNSIGNED-TAIL (crash-orphaned

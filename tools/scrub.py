@@ -56,9 +56,15 @@ def main() -> None:
     os.environ["HM_RECOVER"] = "0"
     # a dry run must not eat the crash marker: closing the backend
     # below marks the repo clean, which would skip the automatic
-    # recovery on the next real open
+    # recovery on the next real open. Its CONTENT (the crashed
+    # session's generation stamp, which bounds the recovery scan to
+    # the journal's dirty ledger) must survive byte-for-byte too.
     marker = os.path.join(args.repo, "repo.dirty")
     was_dirty = os.path.exists(marker)
+    marker_bytes = b""
+    if was_dirty:
+        with open(marker, "rb") as fh:
+            marker_bytes = fh.read()
     back = RepoBackend(path=args.repo)
     try:
         report = recover_repo(back, repair=not args.dry_run)
@@ -94,6 +100,26 @@ def main() -> None:
                 f"clamped "
                 f"({report['t_recover_ms']}ms)"
             )
+            wal = report.get("wal") or {}
+            if wal.get("present"):
+                replayed = wal.get(
+                    "replay_would" if args.dry_run else "replayed", 0
+                )
+                rverb = "would replay" if args.dry_run else "replayed"
+                print(
+                    f"  journal: {wal['records']} record(s) over "
+                    f"{wal['dirty_feeds']} dirty feed(s), {rverb} "
+                    f"{replayed} block(s), "
+                    f"{wal.get('skipped', 0)} already in the logs, "
+                    f"{wal['torn_bytes']}B torn tail"
+                    + (
+                        f"; scan bounded to the session ledger "
+                        f"({report.get('feeds_skipped', 0)} sidecar(s) "
+                        "skipped)"
+                        if wal.get("bounded")
+                        else "; stamp mismatch: full scan"
+                    )
+                )
             for name, entry in sorted(
                 report.get("per_feed", {}).items()
             ):
@@ -109,7 +135,8 @@ def main() -> None:
     finally:
         back.close()
         if args.dry_run and was_dirty:
-            open(marker, "wb").close()
+            with open(marker, "wb") as fh:
+                fh.write(marker_bytes)
 
 
 if __name__ == "__main__":

@@ -7,7 +7,11 @@ ticks/s, replication frames/s, TCP bytes/s, fsync barriers/s, mesh
 dispatches/s, pipeline queue depths — and, since ISSUE 11, the
 read-serving tier's serve.* block: reads/s, batched dispatches/s,
 residency hit/install/eviction rates, fallbacks/s (the [serve] group;
-`python tools/serve.py --ipc <sock>` exposes the same socket).
+`python tools/serve.py --ipc <sock>` exposes the same socket). Since
+ISSUE 14 the ``[wal]`` group renders the group-commit journal's
+``storage.wal.*`` rates — appends/s vs fsyncs/s (the O(1)-fsync-per-
+window claim as a live ratio), checkpoints/s, journal bytes/s, and
+replayed blocks (recovery).
 
 Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
 the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
@@ -105,6 +109,11 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
     by_sub = {}
     for name, v in counters.items():
         sub = name.split(".", 1)[0]
+        if name.startswith("storage.wal."):
+            # the group-commit journal gets its own rate group: one
+            # glance shows appends vs fsyncs (the O(1)-per-window
+            # claim as a live ratio) plus checkpoint/byte flow
+            sub = "wal"
         by_sub.setdefault(sub, []).append((name, v))
     lines = []
     for sub in sorted(by_sub):
