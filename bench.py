@@ -611,6 +611,150 @@ def _config_churn(n_docs=6, n_edits=40):
                 os.environ[k] = v
 
 
+def _config_swarm(n_peers=None, n_edits=24):
+    """BASELINE round-19 fleet config: N in-process daemons joined
+    ONLY through the DHT (net/discovery/ — no explicit connect()
+    anywhere), a subset killed and healed by a seeded FaultPlan
+    mid-burst, bounded gossip fanout active. Measures the wall from
+    first edit to every surviving peer holding the creator's doc
+    BIT-IDENTICAL, mean DHT lookup hops, and per-peer frame
+    amplification (replication frames sent per edit per peer) — the
+    number HM_GOSSIP_FANOUT must bound regardless of peer count."""
+    import time as _t
+
+    from hypermerge_tpu import telemetry as _tele
+    from hypermerge_tpu.net.discovery import DhtNode, DhtSwarm
+    from hypermerge_tpu.net.faults import FaultPlan, FaultSwarm
+    from hypermerge_tpu.repo import Repo
+
+    if n_peers is None:
+        n_peers = int(os.environ.get("BENCH_SWARM_PEERS", "16"))
+    fanout = 4
+    env_save = {
+        k: os.environ.get(k)
+        for k in (
+            "HM_REDIAL_BASE_MS", "HM_REDIAL_MAX_S", "HM_DHT_ANNOUNCE_S",
+            "HM_DHT_LOOKUP_S", "HM_GOSSIP_FANOUT",
+            "HM_GOSSIP_RESHUFFLE_S", "HM_NET_PING_S",
+        )
+    }
+    boot = None
+    repos, swarms, faulted = [], [], []
+    try:
+        os.environ["HM_REDIAL_BASE_MS"] = "50"
+        os.environ["HM_REDIAL_MAX_S"] = "1"
+        os.environ["HM_DHT_ANNOUNCE_S"] = "0.5"
+        os.environ["HM_DHT_LOOKUP_S"] = "0.5"
+        os.environ["HM_GOSSIP_FANOUT"] = str(fanout)
+        os.environ["HM_GOSSIP_RESHUFFLE_S"] = "0.5"
+        os.environ["HM_NET_PING_S"] = "0"  # N^2 keepalive threads off
+        boot = DhtNode()
+        # ~1/5 of the fleet churns: seeded kill mid-burst, heal after
+        n_churn = max(1, n_peers // 5)
+        for i in range(n_peers):
+            r = Repo(memory=True)
+            sw = DhtSwarm(bootstrap=[boot.address])
+            if 0 < i <= n_churn:  # never the creator
+                plan = FaultPlan(
+                    seed=19 + i, events=[(1, "kill"), (2, "heal")]
+                )
+                sw = FaultSwarm(sw, plan)
+                faulted.append(sw)
+            r.set_swarm(sw)
+            repos.append(r)
+            swarms.append(sw)
+        url = repos[0].create({"edits": []})
+        handles = [r.open(url) for r in repos[1:]]
+        for h in handles:
+            # pure-DHT discovery: announce/lookup walks find the
+            # creator (and each other) with no addresses exchanged
+            assert h.value(timeout=120) is not None
+        frames0 = [
+            r.back.network.replication.stats["frames_tx"] for r in repos
+        ]
+        snap0 = _tele.snapshot()
+        t0 = _t.perf_counter()
+        third = max(1, n_edits // 3)
+        for i in range(n_edits):
+            repos[0].change(url, lambda d, i=i: d["edits"].append(i))
+            if i == third:
+                for fs in faulted:
+                    fs.tick()  # kill fires: churned peers drop
+            if i == 2 * third:
+                for fs in faulted:
+                    fs.tick()  # heal: supervised redial + resync
+        for fs in faulted:
+            while fs.plan.tick < 2:
+                fs.tick()
+        deadline = _t.perf_counter() + 180
+        want = list(range(n_edits))
+        while _t.perf_counter() < deadline:
+            vals = [h.value() for h in handles]
+            if all(
+                v is not None and v.get("edits") == want for v in vals
+            ):
+                break
+            _t.sleep(0.02)
+        else:
+            raise AssertionError("config_swarm did not converge")
+        dt = _t.perf_counter() - t0
+        # acked state must be BIT-identical across every peer
+        blobs = {
+            json.dumps(h.value(), sort_keys=True) for h in handles
+        }
+        blobs.add(json.dumps(repos[0].doc(url), sort_keys=True))
+        assert len(blobs) == 1, "diverged doc state across peers"
+        frames = [
+            r.back.network.replication.stats["frames_tx"] - f0
+            for r, f0 in zip(repos, frames0)
+        ]
+        amp = [f / n_edits for f in frames]
+        snap1 = _tele.snapshot()
+        lookups = snap1.get("dht.lookups", 0) - snap0.get(
+            "dht.lookups", 0
+        )
+        hops = snap1.get("dht.lookup_hops", 0) - snap0.get(
+            "dht.lookup_hops", 0
+        )
+        counters = {
+            "peers": n_peers,
+            "churned": len(faulted),
+            "fanout": fanout,
+            "frame_amp_max": round(max(amp), 1),
+            "frame_amp_mean": round(sum(amp) / len(amp), 1),
+            "lookup_hops_mean": round(hops / max(lookups, 1), 2),
+            "reconnects": sum(
+                sup.stats["reconnects"]
+                for sup in (
+                    getattr(sw, "supervisor", None) for sw in swarms
+                )
+                if sup is not None
+            ),
+        }
+        # the fleet claim: per-peer frames stay O(fanout), not O(peers)
+        # (generous slack for relay hops + announce/length frames)
+        assert counters["frame_amp_max"] <= 4 * fanout + 8, counters
+        return dt, counters
+    finally:
+        for r in repos:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for sw in swarms:
+            try:
+                sw.destroy()
+            except Exception:
+                pass
+        if boot is not None:
+            boot.close()
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 _CRASH_CHILD = r"""
 import os, sys
 sys.path.insert(0, sys.argv[2])
@@ -1394,6 +1538,17 @@ def main() -> None:
             f"churn {cfgch[2]})",
             file=sys.stderr,
         )
+    cfgsw = _soft("config_swarm", _config_swarm)
+    if cfgsw is not None:
+        print(
+            f"# config_swarm DHT fleet (no explicit connect, seeded "
+            f"kill/heal churn): converged in {cfgsw[0]:.2f}s "
+            f"({cfgsw[1]['peers']} peers, frame amp "
+            f"max {cfgsw[1]['frame_amp_max']}x vs fanout "
+            f"{cfgsw[1]['fanout']}, lookup hops "
+            f"{cfgsw[1]['lookup_hops_mean']}; {cfgsw[1]})",
+            file=sys.stderr,
+        )
     cfgcr = _soft("config_crash", _config_crash)
     if cfgcr is not None:
         print(
@@ -1531,6 +1686,15 @@ def main() -> None:
                     ),
                     "config_churn": (
                         cfgch[2] if cfgch is not None else None
+                    ),
+                    # DHT fleet: N daemons, discovery-only topology,
+                    # seeded churn; frame amplification must stay
+                    # O(HM_GOSSIP_FANOUT) regardless of peer count
+                    "config_swarm_s": (
+                        round(cfgsw[0], 2) if cfgsw is not None else None
+                    ),
+                    "config_swarm": (
+                        cfgsw[1] if cfgsw is not None else None
                     ),
                     "config_crash_t_recover_ms": (
                         round(cfgcr[0], 1) if cfgcr is not None else None

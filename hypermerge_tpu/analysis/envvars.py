@@ -138,6 +138,28 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "queue; overflowing reads degrade to the host path "
            "(serve.fallbacks) instead of queueing unboundedly."),
     # -- network --------------------------------------------------------
+    EnvVar("HM_DHT_BOOTSTRAP", None, "Comma-separated host:port DHT "
+           "bootstrap nodes (net/discovery/) for DhtSwarm/DhtNode."),
+    EnvVar("HM_DHT_K", "16", "Kademlia k: contacts per routing bucket "
+           "and width of lookup frontiers/replica sets."),
+    EnvVar("HM_DHT_ALPHA", "3", "Concurrent probes per iterative "
+           "lookup round."),
+    EnvVar("HM_DHT_RPC_TIMEOUT_S", "1", "UDP DHT RPC timeout (an "
+           "unanswered liveness ping evicts the bucket LRU)."),
+    EnvVar("HM_DHT_TTL_S", "120", "Announce record time-to-live; a "
+           "crashed peer's stale address evaporates within one TTL."),
+    EnvVar("HM_DHT_ANNOUNCE_S", "30", "Re-announce period for joined "
+           "ids with announce posture (keep well under HM_DHT_TTL_S)."),
+    EnvVar("HM_DHT_LOOKUP_S", "10", "Lookup refresh period for joined "
+           "ids with lookup posture (resamples the active view)."),
+    EnvVar("HM_DHT_TARGETS", "4", "Bounded active view: max supervised "
+           "dials per joined id out of the announcers a lookup found "
+           "(0 = dial every announcer)."),
+    EnvVar("HM_GOSSIP_FANOUT", "8", "Per-doc active replication/gossip "
+           "fanout cap (random peer subset; 0 = broadcast to every "
+           "peer). Anti-entropy sweeps stay unsampled."),
+    EnvVar("HM_GOSSIP_RESHUFFLE_S", "5", "How long a gossip sample "
+           "stays fixed before reshuffling to a fresh peer subset."),
     EnvVar("HM_GOSSIP_FLUSH_MS", "10", "Window of the cursor/clock "
            "gossip broadcast debouncer."),
     EnvVar("HM_GOSSIP_FRESH", "1", "Overlay pending store rows onto "

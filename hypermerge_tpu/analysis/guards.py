@@ -223,6 +223,58 @@ GUARDS: Tuple[GuardedClass, ...] = (
             "(NetworkPeer.try_send) instead of check-then-use.",
     ),
     GuardedClass(
+        "RoutingTable", "hypermerge_tpu.net.discovery.dht", "net.dht",
+        guarded=("_buckets", "_replacements", "_probing"),
+        init_only=("self_id", "k"),
+        doc="The k-bucket array and per-bucket replacement caches "
+            "mutate under net.dht only (observe/refresh/evict/closest "
+            "from the UDP reader thread, lookup walkers, and timeout "
+            "timers); liveness probes run outside it.",
+    ),
+    GuardedClass(
+        "RecordStore", "hypermerge_tpu.net.discovery.dht",
+        "net.dht.store",
+        guarded=("_records",),
+        doc="The signed announce-record table (reader thread stores, "
+            "lookup walkers and lazy expiry read) mutates under "
+            "net.dht.store; signature verification runs before the "
+            "lock.",
+    ),
+    GuardedClass(
+        "DhtNode", "hypermerge_tpu.net.discovery.dht", "net.dht.rpc",
+        guarded=("_pending",),
+        init_only=("table", "records", "_rpc_ids", "bootstrap",
+                   "public_key", "id"),
+        unguarded=("_closed", "_announce_seed", "_seed"),
+        doc="The pending-RPC correlation table mutates under "
+            "net.dht.rpc (reader thread resolves, timers expire, "
+            "senders register). `_closed` is a monotonic shutdown "
+            "latch polled by the reader; `_announce_seed` is set-once "
+            "wiring installed by set_identity before any join "
+            "traffic; `_seed` is the construction-time node key.",
+    ),
+    GuardedClass(
+        "DhtSwarm", "hypermerge_tpu.net.discovery.swarm",
+        "net.dht.swarm",
+        guarded=("_joined", "_targets", "_pass_waiters"),
+        init_only=("tcp", "node", "_rng", "_kick", "_stop", "_thread"),
+        unguarded=("_need",),
+        doc="The joined-id table and the sampled active-view targets "
+            "mutate under net.dht.swarm (join/leave callers vs the "
+            "maintenance thread); dials and DHT walks run outside "
+            "it. `_need` is set-once wiring (Network.set_swarm "
+            "installs the demand hook before any join traffic).",
+    ),
+    GuardedClass(
+        "GossipSampler", "hypermerge_tpu.net.discovery.gossip",
+        "net.gossip",
+        guarded=("_samples",),
+        init_only=("fanout", "reshuffle_s", "_rng"),
+        doc="The per-key sample table mutates under net.gossip; the "
+            "hot broadcast paths hold it for dict bookkeeping only. "
+            "`_rng` is only ever driven under the lock.",
+    ),
+    GuardedClass(
         "_FrontendHub", "hypermerge_tpu.net.ipc", "net.ipc.hub",
         guarded=("_conns", "_interest", "_next_key"),
         init_only=("_back",),

@@ -310,6 +310,34 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "net.tcp.server", None,
         "TcpSwarm._dlock — live duplex tracking.",
     ),
+    LockClass(
+        "net.dht", None,
+        "discovery.dht RoutingTable._lock — the k-bucket array + "
+        "replacement caches. Pure table bookkeeping; liveness probes "
+        "fire OUTSIDE it.",
+    ),
+    LockClass(
+        "net.dht.store", None,
+        "discovery.dht RecordStore._lock — the signed announce-record "
+        "table (verification runs before the lock).",
+    ),
+    LockClass(
+        "net.dht.rpc", None,
+        "discovery.dht DhtNode._plock — the pending-RPC correlation "
+        "table (reader thread vs timeout timers vs senders).",
+    ),
+    LockClass(
+        "net.dht.swarm", None,
+        "discovery.swarm DhtSwarm._lock — the joined-id and "
+        "active-view target tables (join/leave callers vs the "
+        "maintenance thread).",
+    ),
+    LockClass(
+        "net.gossip", None,
+        "discovery.gossip GossipSampler._lock — the per-key sample "
+        "table. Held for dict bookkeeping only (the hot broadcast "
+        "paths call sample()).",
+    ),
     LockClass("net.fault.plan", None, "FaultPlan._lock — RNG streams."),
     LockClass(
         "net.fault.delay", None,

@@ -14,6 +14,7 @@ columnar batch and materializes them in a single device dispatch
 
 from __future__ import annotations
 
+import functools
 import math
 import os
 import threading
@@ -54,6 +55,30 @@ from .metadata import Metadata
 # device->host summary-wire transfer bytes (same series sharded.py's
 # collective gather feeds; handle cached — one per-slab bump)
 _M_D2H = telemetry.counter("mesh.d2h_bytes")
+
+
+# actor id -> discovery id is a pure hash of an immutable key: memoize
+# it for the telemetry payload's per-poll sweep over every doc's actors
+_discovery_id_cached = functools.lru_cache(maxsize=65536)(
+    keymod.discovery_id
+)
+
+
+def _merge_store_marks(old, new):
+    """Within-window merge for the debounced store flusher's marks:
+    clock dicts merge per-actor max-wins (two cursor-gossip frames in
+    one window must not drop the older frame's actors), cursor seqs
+    take the max. The sqlite upserts are monotonic anyway; this keeps
+    the in-window view equally monotonic."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        out = dict(old)
+        for k, v in new.items():
+            if v > out.get(k, 0):
+                out[k] = v
+        return out
+    if isinstance(old, int) and isinstance(new, int):
+        return max(old, new)
+    return new
 
 
 class RepoBackend:
@@ -271,6 +296,7 @@ class RepoBackend:
             self._flush_store_rows,
             window_s=float(os.environ.get("HM_STORE_FLUSH_MS", "5"))
             / 1e3,
+            merge=_merge_store_marks,
             name="stores",
         )
         # read once: _mark_clock_row/_mark_cursor_row run per patch,
@@ -345,6 +371,25 @@ class RepoBackend:
                 io_fsync(fh)
         except OSError as e:
             log("repo:backend", f"stamp invalidation failed: {e}")
+
+    def hydrate_feeds(self) -> int:
+        """Open every feed the repo has on record (the feeds table) so
+        a daemon ANNOUNCES and SERVES all its docs without waiting for
+        a doc open — the fleet posture (net/ipc.py --dht joins the
+        swarm before any frontend attaches; an unopened feed would
+        neither join discovery nor answer DiscoveryIds). Persisted
+        secret keys re-bind writability exactly as in
+        _get_or_create_actor; opening a feed is storage-light (no CRDT
+        materialization). Returns the number of feeds on record."""
+        n = 0
+        for pk in self.feed_info.all_public_ids():
+            pair = self._actor_keys.get(pk)
+            if pair is not None:
+                self.feeds.create(pair)
+            else:
+                self.feeds.open_feed(pk)
+            n += 1
+        return n
 
     def identity_seed(self) -> Optional[bytes]:
         """The repo's static ed25519 seed for transport authentication
@@ -1737,9 +1782,14 @@ class RepoBackend:
     def _flush_store_rows(self, batch: Dict) -> None:
         clocks: Dict[str, Dict[str, int]] = {}
         cursor_rows = []
+        # remote peers' clock rows (cursor-gossip ingest), grouped by
+        # the SENDER repo id the row is recorded under
+        remote: Dict[str, Dict[str, Dict[str, int]]] = {}
         for key, val in batch.items():
             if key[0] == "c":
                 clocks[key[1]] = val
+            elif key[0] == "r":
+                remote.setdefault(key[1], {})[key[2]] = val
             else:
                 cursor_rows.append((key[1], key[2], val))
         # durability ordering: a clock row must never COMMIT ahead of
@@ -1755,6 +1805,8 @@ class RepoBackend:
                     self.clocks.update_many(self.id, clocks)
                 if cursor_rows:
                     self.cursors.update_many_rows(self.id, cursor_rows)
+                for rid, docs in remote.items():
+                    self.clocks.update_many(rid, docs)
 
     def _doc_notify(self, event: Dict[str, Any]) -> None:
         t = event["type"]
@@ -1921,6 +1973,40 @@ class RepoBackend:
         payload = telemetry.query_payload()
         if self.serve is not None:
             payload["serve"] = self.serve.residency_report()
+        if self.network is not None:
+            # DHT introspection (DhtSwarm.discovery_report: node id,
+            # bucket occupancy, records, joined posture) for
+            # tools/meta.py --dht and the tools/ls.py header
+            dht = self.network.discovery_report()
+            if dht is not None:
+                payload["dht"] = dht
+            # per-doc swarm view for the tools/ls.py peers=/announce=
+            # columns: connected peers replicating each open doc, and
+            # whether the doc's feeds are joined (announced/looked-up).
+            # Built entirely from the cursor MIRROR + memoized
+            # discovery ids: Telemetry is polled ~1/s by tools/top.py,
+            # and a per-doc SQL query + per-actor sha1 would put
+            # O(docs x peers) work on every poll of a fleet daemon.
+            docs_net: Dict[str, Any] = {}
+            joined = self.network.joined
+            repl = self.network.replication
+            # docs on RECORD, not just open ones: a fleet daemon
+            # (hydrate_feeds) serves docs no frontend ever opened
+            doc_ids = set(self.docs.keys())
+            doc_ids.update(self.clocks.all_doc_ids(self.id))
+            for doc_id in doc_ids:
+                dids = [
+                    _discovery_id_cached(a)
+                    for a in self.cursors.get(self.id, doc_id)
+                ]
+                peers: set = set()
+                for d in dids:
+                    peers.update(repl.peers_with_feed(d))
+                docs_net[doc_id] = {
+                    "peers": len(peers),
+                    "announced": any(d in joined for d in dids),
+                }
+            payload["net"] = {"docs": docs_net}
         return payload
 
     def handle_query(self, query_id: int, query: Dict[str, Any]) -> None:
@@ -1997,8 +2083,18 @@ class RepoBackend:
         reflects changes we actually applied (else we'd advertise state we
         can't supply to third parties)."""
         before = self.cursors.get(self.id, doc_id)
-        after = self.cursors.update(self.id, doc_id, cursors)
-        self.clocks.update(peer.id, doc_id, clocks)
+        if self._store_debounce:
+            # hot ingest path (a fleet doc gossips one actor per
+            # peer): merge the write-through MIRROR now, ride the
+            # debounced flusher for the sqlite rows — one executemany
+            # per window instead of O(actors) per inbound frame
+            after = self.cursors.merge_mem(self.id, doc_id, cursors)
+            for a, s in cursors.items():
+                self._stores.mark(("u", doc_id, a), s)
+            self._stores.mark(("r", peer.id, doc_id), dict(clocks))
+        else:
+            after = self.cursors.update(self.id, doc_id, cursors)
+            self.clocks.update(peer.id, doc_id, clocks)
         doc = self.docs.get(doc_id)
         if doc is not None:
             doc.update_minimum_clock(clocks)
@@ -2016,11 +2112,50 @@ class RepoBackend:
         src/RepoBackend.ts:374-392)."""
         pend = self._stores.pending()  # one snapshot for the loop
         for doc_id in self.cursors.docs_with_actor(self.id, public_id):
+            # an open doc's in-memory clock is authoritative (and
+            # fresher than its debounced store row); the store read is
+            # the cold-doc fallback only — discovery fires once per
+            # (feed, peer) and a fleet doc has O(peers) feeds, so a
+            # SQL query here lands on the hottest wiring path
+            doc = self.docs.get(doc_id)
+            clock = (
+                dict(doc.clock) if doc is not None
+                else self.clocks.get(self.id, doc_id)
+            )
             cursor, clock = self._overlay_pending_rows(
                 doc_id,
                 self.cursors.get(self.id, doc_id),
-                self.clocks.get(self.id, doc_id),
+                clock,
                 pend=pend,
+            )
+            self.network.send_cursor_to(peer, doc_id, cursor, clock)
+
+    def send_sweep_cursors(self, peer, public_ids) -> None:
+        """Anti-entropy cursor repair (ReplicationManager.on_sweep):
+        re-send our cursor+clock for every doc sharing an actor with
+        `peer` — ONE cursor frame per doc per sweep, iterated doc-side
+        (O(docs) store reads) rather than feed-side (a fleet doc
+        carries one placeholder actor per peer, so per-feed iteration
+        is O(peers) SQL per sweep). Idempotent latest-state: this is
+        what bounds the staleness of a bounded-fanout cursor gossip
+        the peer wasn't sampled into (net/discovery/gossip.py)."""
+        if self.network is None or self._closed:
+            return
+        pks = set(public_ids)
+        pend = self._stores.pending()
+        doc_ids = set(self.docs.keys())
+        doc_ids.update(self.clocks.all_doc_ids(self.id))
+        for doc_id in doc_ids:
+            cursor = self.cursors.get(self.id, doc_id)
+            if not pks.intersection(cursor):
+                continue
+            doc = self.docs.get(doc_id)
+            clock = (
+                dict(doc.clock) if doc is not None
+                else self.clocks.get(self.id, doc_id)
+            )
+            cursor, clock = self._overlay_pending_rows(
+                doc_id, cursor, clock, pend=pend,
             )
             self.network.send_cursor_to(peer, doc_id, cursor, clock)
 

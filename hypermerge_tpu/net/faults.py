@@ -378,6 +378,22 @@ class FaultSwarm(Swarm):
     def set_identity(self, seed) -> None:
         self.inner.set_identity(seed)
 
+    def set_need_hook(self, fn) -> None:
+        """Demand-driven lookup passthrough (DhtSwarm under faults)."""
+        inner = getattr(self.inner, "set_need_hook", None)
+        if inner is not None:
+            inner(fn)
+
+    def discovery_report(self):
+        """DHT introspection passthrough (DhtSwarm under faults)."""
+        fn = getattr(self.inner, "discovery_report", None)
+        return fn() if fn is not None else None
+
+    @property
+    def supervisor(self):
+        """Redial-supervisor passthrough (Tcp/DhtSwarm under faults)."""
+        return getattr(self.inner, "supervisor", None)
+
     def join(self, discovery_id: str, options=None) -> None:
         if options is None:
             self.inner.join(discovery_id)

@@ -223,12 +223,18 @@ def serve_backend(
     tcp_listen: bool = False,
     tcp_connect: Optional[list] = None,
     hub: bool = False,
+    dht: bool = False,
+    dht_bootstrap: Optional[list] = None,
 ) -> None:
     """Host a RepoBackend behind a unix socket. `once` serves a single
     frontend connection then returns (the reference pairs exactly one
     frontend per backend). With `tcp_listen`/`tcp_connect` the backend
     process also joins the peer swarm over TCP (the daemon owns the
-    networking; the frontend process needs none of it loaded)."""
+    networking; the frontend process needs none of it loaded). With
+    `dht` it joins fleet-style instead (net/discovery/ DhtSwarm): dial
+    targets come from DHT announce/lookup — no addresses to configure
+    beyond `dht_bootstrap` ("host:port" strings; default
+    HM_DHT_BOOTSTRAP)."""
     from ..backend.repo_backend import RepoBackend
 
     if os.path.exists(sock_path):
@@ -246,7 +252,28 @@ def serve_backend(
         # the daemon's repo + swarm come up BEFORE a frontend attaches:
         # it replicates with peers on its own; the frontend is a client
         back = RepoBackend(path=repo_path, memory=memory)
-        if tcp_listen or tcp_connect:
+        if dht or dht_bootstrap:
+            from .discovery import DhtSwarm
+
+            bootstrap = None
+            if dht_bootstrap:
+                bootstrap = []
+                for addr in dht_bootstrap:
+                    h, _, p = addr.rpartition(":")
+                    bootstrap.append((h, int(p)))
+            swarm = DhtSwarm(bootstrap=bootstrap)
+            # fleet posture: every feed on record joins discovery NOW
+            # (announce + serve), not at first frontend/doc open
+            back.hydrate_feeds()
+            back.set_swarm(swarm)
+            th, tp = swarm.address
+            dh, dp = swarm.dht_address
+            print(
+                f"dht node {swarm.node.id_hex[:12]}… udp {dh}:{dp} "
+                f"swarm listening on {th}:{tp}",
+                flush=True,
+            )
+        elif tcp_listen or tcp_connect:
             from .tcp import TcpSwarm
 
             swarm = TcpSwarm()
@@ -369,6 +396,18 @@ def main() -> None:
         help="join the peer swarm: dial another backend (repeatable)",
     )
     ap.add_argument(
+        "--dht", action="store_true",
+        help="join the peer swarm fleet-style via the DHT "
+        "(net/discovery/): announce/lookup by doc id, no explicit "
+        "addresses; bootstrap from --dht-bootstrap or "
+        "HM_DHT_BOOTSTRAP",
+    )
+    ap.add_argument(
+        "--dht-bootstrap", action="append", default=[],
+        metavar="HOST:PORT",
+        help="DHT bootstrap node (repeatable; implies --dht)",
+    )
+    ap.add_argument(
         "--persist", action="store_true",
         help="keep serving after a frontend disconnects (ONE live "
         "backend is reused across frontend cycles: swarm port and "
@@ -389,6 +428,8 @@ def main() -> None:
         tcp_listen=args.listen,
         tcp_connect=args.connect,
         hub=args.hub,
+        dht=args.dht,
+        dht_bootstrap=args.dht_bootstrap,
     )
 
 

@@ -38,8 +38,22 @@ class Network:
         self.peers: Dict[str, NetworkPeer] = {}
         self.closed_connection_count = 0
         self._lock = make_rlock("net.network")
+        # bounded gossip relay (net/discovery/gossip.py): the
+        # REPAIRABLE broadcast paths — replication live tails, cursor
+        # gossip — target at most HM_GOSSIP_FANOUT peers per doc;
+        # anti-entropy sweeps (and ephemeral doc messages, which have
+        # no repair path) stay unsampled so convergence is bounded
+        from .discovery.gossip import GossipSampler
+
+        self.gossip = GossipSampler()
         self.replication = ReplicationManager(
-            backend.feeds, self._on_feed_discovery
+            backend.feeds, self._on_feed_discovery, sampler=self.gossip
+        )
+        # sweep-time cursor repair: the anti-entropy pass re-sends doc
+        # cursors a sampled gossip may have skipped (None for minimal
+        # test backends that carry no cursor store)
+        self.replication.on_sweep = getattr(
+            backend, "send_sweep_cursors", None
         )
 
     # ------------------------------------------------------------------
@@ -72,6 +86,16 @@ class Network:
         set_id = getattr(swarm, "set_identity", None)
         if set_id is not None:
             set_id(self.backend.identity_seed())
+        # demand-driven discovery (DhtSwarm): a lookup walk + dial only
+        # while NO verified peer replicates the id — one connection
+        # replicates every shared feed, so satisfied ids spend no
+        # walk/dial budget, and a doc whose peers all churned away
+        # flips back to needing one
+        set_need = getattr(swarm, "set_need_hook", None)
+        if set_need is not None:
+            set_need(
+                lambda did: not self.replication.peers_with_feed(did)
+            )
         swarm.on_connection(self._on_connection)
         for did in self.backend.feeds.known_discovery_ids():
             self.join(did)
@@ -268,14 +292,26 @@ class Network:
     def gossip_cursor(
         self, doc_id: str, cursor: clockmod.Clock, clock: clockmod.Clock
     ) -> None:
-        for peer in self._peers_for_doc(doc_id):
+        peers = self.gossip.sample(doc_id, list(self._peers_for_doc(doc_id)))
+        for peer in peers:
             self.send_cursor_to(peer, doc_id, cursor, clock)
 
     def broadcast_doc_message(self, doc_id: str, contents: Any) -> None:
+        # deliberately UNSAMPLED: ephemeral doc messages are one-shot
+        # with no relay hop (receivers only deliver to their frontend)
+        # and no anti-entropy repair — a sampled-away peer would lose
+        # the message forever, not late. The bounded-fanout claim
+        # covers the repairable paths (live tails, cursor gossip).
         for peer in self._peers_for_doc(doc_id):
             peer.try_send(
                 MSGS_CHANNEL, msgs.document_message(doc_id, contents)
             )
+
+    def discovery_report(self) -> Optional[Dict[str, Any]]:
+        """The attached swarm's DHT introspection block, when it has
+        one (DhtSwarm.discovery_report; FaultSwarm passes through)."""
+        fn = getattr(self.swarm, "discovery_report", None)
+        return fn() if fn is not None else None
 
     # ------------------------------------------------------------------
 

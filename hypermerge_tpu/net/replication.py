@@ -103,9 +103,17 @@ class ReplicationManager:
         self,
         feeds: FeedStore,
         on_discovery: Callable[[str, NetworkPeer], None],
+        sampler=None,
     ) -> None:
         self.feeds = feeds
         self._on_discovery = on_discovery
+        # bounded gossip relay (net/discovery/gossip.py GossipSampler
+        # or None = broadcast): live-tail flushes target a per-feed
+        # sampled peer subset so a hot doc's frame cost stays
+        # O(fanout), not O(peers); receivers relay to THEIR samples
+        # (their on_extended marks their flusher), and the unsampled
+        # anti-entropy sweep bounds any straggler by one period
+        self._sampler = sampler
         self._lock = make_rlock("net.repl")
         self._peers: Set[NetworkPeer] = set()
         # discovery_id -> peers replicating it with us. Membership
@@ -161,6 +169,10 @@ class ReplicationManager:
         self._ae_interval = _antientropy_s()
         self._ae_stop = threading.Event()
         self._ae_thread: Optional[threading.Thread] = None
+        # sweep-time cursor repair hook: called (peer, public_keys)
+        # once per peer per sweep (Network wires it to
+        # RepoBackend.send_sweep_cursors). Set before traffic flows.
+        self.on_sweep: Optional[Callable] = None
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -173,6 +185,8 @@ class ReplicationManager:
             "antientropy_sweeps": int(
                 m["antientropy_sweeps"].value()
             ),
+            "frames_tx": int(m["frames_tx"].value()),
+            "frames_rx": int(m["frames_rx"].value()),
         }
 
     # ------------------------------------------------------------------
@@ -750,6 +764,10 @@ class ReplicationManager:
     def _flush_feed(self, feed: Feed, start: int) -> None:
         did = feed.discovery_id
         peers = self.peers_with_feed(did)
+        if self._sampler is not None:
+            # bounded fanout: the tail rides to a sampled subset; the
+            # rest converge via relay hops and the anti-entropy sweep
+            peers = self._sampler.sample(did, peers)
         if not peers:
             return
         head = feed.length
@@ -796,11 +814,15 @@ class ReplicationManager:
     def sweep_now(self) -> int:
         """One anti-entropy pass NOW (the timer's body; tests call it
         directly): re-announce our length for every feed each verified
-        peer replicates with us. Lengths are idempotent latest-state —
-        a peer that already matches ignores it; a peer that lost a
-        tail frame (app-layer loss on a surviving connection) or
-        truncated in crash recovery requests the gap. Returns frames
-        sent."""
+        peer replicates with us, and re-fire the discovery hook so the
+        repo re-sends its CURSORS for the docs those feeds belong to.
+        Both are idempotent latest-state — a peer that already matches
+        ignores them; a peer that lost a tail frame (app-layer loss on
+        a surviving connection), truncated in crash recovery, or
+        missed a SAMPLED cursor gossip (the bounded-fanout relay,
+        net/discovery/gossip.py — a one-shot broadcast a peer wasn't
+        sampled into would otherwise be lost forever) requests the gap
+        within one sweep period. Returns frames sent."""
         with self._lock:
             peers = list(self._peers)
         sent = 0
@@ -809,14 +831,31 @@ class ReplicationManager:
                 continue
             with self._lock:
                 dids = list(self._verified.keys_with(peer))
+            pks = []
             for did in dids:
                 feed = self.feeds.by_discovery_id(did)
                 if feed is None:
+                    continue
+                pks.append(feed.public_key)
+                if feed.length == 0:
+                    # nothing to repair FROM us: a zero-length feed's
+                    # holder side announces (a fleet doc carries one
+                    # empty placeholder feed per peer — re-announcing
+                    # them all every sweep is O(peers^2) noise)
                     continue
                 msg = self._feed_length_msg(feed, peer)
                 if msg is not None:
                     self._send(peer, msg)
                     sent += 1
+            if self.on_sweep is not None and pks:
+                # cursor repair (ONE pass per peer, not per feed): a
+                # bounded-fanout cursor gossip the peer wasn't sampled
+                # into is one-shot — this bounds that staleness by the
+                # sweep period (RepoBackend.send_sweep_cursors)
+                try:
+                    self.on_sweep(peer, pks)
+                except Exception as e:  # repo-side hook bug: keep sweeping
+                    log("replication", f"sweep cursor hook failed: {e}")
         self._m["antientropy_sweeps"].add(1)
         return sent
 

@@ -198,6 +198,13 @@ class SessionSupervisor:
             sessions = list(self._sessions.values())
         for s in sessions:
             s.kick()
+            # a session parked on a LIVE connection waits on the
+            # connection-done event, not the backoff wake: set it too,
+            # or every stop() pays the full join timeout per connected
+            # session (at fleet scale that is the whole teardown)
+            done = getattr(s, "_conn_done", None)
+            if done is not None:
+                done.set()
         # bounded join before retiring the series: a session thread
         # bumping `dials` after the fold would land on a dropped
         # handle (kick() already interrupts backoff sleeps; only a
@@ -298,6 +305,8 @@ class SessionSupervisor:
             # races the teardown accounting. A duplex that closed in
             # between fires the listener immediately.
             closed = threading.Event()
+            s._conn_done = closed  # stop() sets it (see above): a
+            # supervisor teardown must not wait out a healthy link
             duplex.on_close(closed.set)
             closed.wait()
             if self._stopped:

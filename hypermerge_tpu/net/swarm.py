@@ -102,6 +102,13 @@ class LoopbackHub:
         options: JoinOptions = DEFAULT_JOIN,
     ) -> None:
         with self._lock:
+            if discovery_id not in swarm.joined:
+                # a leave raced this join (the swarm records intent
+                # BEFORE calling the hub, in both directions): the
+                # leave already ran its hub.leave, so registering now
+                # would strand a member entry that keeps pairing the
+                # departed swarm forever
+                return
             members = self._members.setdefault(discovery_id, [])
             members[:] = [(s, o) for s, o in members if s is not swarm]
             members.append((swarm, options))
@@ -150,6 +157,9 @@ class LoopbackSwarm(Swarm):
         self.hub.join(self, discovery_id, options)
 
     def leave(self, discovery_id: str) -> None:
+        # intent first: a join racing this leave re-checks `joined`
+        # inside the hub lock and cancels itself (LoopbackHub.join), so
+        # a leave also cancels the PENDING join it interleaved with
         self.joined.discard(discovery_id)
         self.hub.leave(self, discovery_id)
 

@@ -323,6 +323,22 @@ class CursorStore:
                 self._absorb(repo_id, doc_id, a, _clamp(s))
             return dict(self._repo(repo_id).get(doc_id, {}))
 
+    def merge_mem(
+        self, repo_id: str, doc_id: str, clock: clockmod.Clock
+    ) -> clockmod.Clock:
+        """Mirror-only monotonic merge, returning the merged cursor.
+        The durable sqlite rows ride the caller's DEBOUNCED store
+        flush (RepoBackend._stores -> update_many_rows): cursor gossip
+        ingest is the fleet's hottest message path, and a synchronous
+        executemany per inbound frame puts sqlite on it O(actors) deep
+        (a fleet doc carries one actor per peer). Crash safety is
+        unchanged: cursor rows rebuild from feeds on recovery."""
+        self._ensure_hydrated(repo_id)
+        with self._lock:
+            for a, s in clock.items():
+                self._absorb(repo_id, doc_id, a, _clamp(s))
+            return dict(self._repo(repo_id).get(doc_id, {}))
+
     def update_many_rows(
         self, repo_id: str, rows: Iterable[Tuple[str, str, int]]
     ) -> None:

@@ -1,0 +1,129 @@
+"""The fleet soak (-m slow): 50 in-process daemons joined ONLY through
+the DHT (net/discovery/ — no connect() anywhere), a seeded fifth of the
+fleet hard-killed mid-burst and healed, every surviving peer converging
+BIT-identically, and per-peer frame amplification bounded by the gossip
+fanout instead of the peer count.
+
+Runs uninstrumented on purpose: at 50 repos the lockdep/racedep
+descriptor overhead dominates the wall clock; the discovery classes'
+guard/lock coverage lives in tests/test_discovery.py (tier-1, fully
+instrumented)."""
+
+import json
+import time
+
+import pytest
+
+from hypermerge_tpu.net.discovery import DhtNode, DhtSwarm
+from hypermerge_tpu.net.faults import FaultPlan, FaultSwarm
+from hypermerge_tpu.repo import Repo
+
+pytestmark = pytest.mark.slow
+
+
+def test_fifty_peer_churn_soak(monkeypatch):
+    n, edits, fanout = 50, 30, 4
+    monkeypatch.setenv("HM_GOSSIP_FANOUT", str(fanout))
+    monkeypatch.setenv("HM_GOSSIP_RESHUFFLE_S", "1")
+    monkeypatch.setenv("HM_DHT_ANNOUNCE_S", "10")
+    monkeypatch.setenv("HM_DHT_LOOKUP_S", "5")
+    monkeypatch.setenv("HM_ANTIENTROPY_S", "3")
+    monkeypatch.setenv("HM_REDIAL_BASE_MS", "30")
+    monkeypatch.setenv("HM_REDIAL_MAX_S", "0.5")
+    monkeypatch.setenv("HM_NET_PING_S", "0")
+    plans = {
+        i: FaultPlan(seed=50 + i, events=[(1, "kill"), (2, "heal")])
+        for i in range(1, n, 5)  # 10 churned peers, never the creator
+    }
+    boot = DhtNode()
+    repos, swarms = [], []
+    try:
+        for i in range(n):
+            r = Repo(memory=True)
+            sw = DhtSwarm(bootstrap=[boot.address])
+            if i in plans:
+                sw = FaultSwarm(sw, plans[i])
+            r.set_swarm(sw)
+            repos.append(r)
+            swarms.append(sw)
+        url = repos[0].create({"edits": []})
+        handles = [r.open(url) for r in repos[1:]]
+        # pure-DHT discovery: all 49 peers find the doc through
+        # announce/lookup walks + relay + anti-entropy alone
+        ready = set()
+        deadline = time.monotonic() + 300
+        while len(ready) < len(handles):
+            assert time.monotonic() < deadline, (
+                f"discovery stalled at {len(ready)}/{len(handles)}"
+            )
+            for i, h in enumerate(handles):
+                if i not in ready:
+                    try:
+                        if h.value(timeout=0.01) is not None:
+                            ready.add(i)
+                    except TimeoutError:
+                        pass
+            time.sleep(0.5)
+        faulted = [swarms[i] for i in plans]
+        third = edits // 3
+        for i in range(edits):
+            repos[0].change(url, lambda d, i=i: d["edits"].append(i))
+            if i == third or i == 2 * third:
+                for fs in faulted:
+                    fs.tick()
+        for fs in faulted:
+            while fs.plan.tick < 2:
+                fs.tick()
+        want = list(range(edits))
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if all(
+                (h.value() or {}).get("edits") == want for h in handles
+            ):
+                break
+            time.sleep(0.5)
+        else:
+            behind = sum(
+                1
+                for h in handles
+                if (h.value() or {}).get("edits") != want
+            )
+            raise AssertionError(f"soak never converged: {behind} behind")
+        blobs = {json.dumps(h.value(), sort_keys=True) for h in handles}
+        blobs.add(json.dumps(repos[0].doc(url), sort_keys=True))
+        assert len(blobs) == 1, "diverged doc state across the fleet"
+        # frame amplification on a STEADY-STATE burst (the O(fanout)
+        # claim): the churn window above accrues discovery + sweep
+        # repair frames that would drown the per-edit signal
+        frames0 = [
+            r.back.network.replication.stats["frames_tx"] for r in repos
+        ]
+        burst = 20
+        for i in range(burst):
+            repos[0].change(
+                url, lambda d, i=i: d["edits"].append(1000 + i)
+            )
+            time.sleep(0.01)
+        want2 = want + [1000 + i for i in range(burst)]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if all(
+                (h.value() or {}).get("edits") == want2
+                for h in handles
+            ):
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError("steady-state burst never converged")
+        amp = max(
+            (r.back.network.replication.stats["frames_tx"] - f0) / burst
+            for r, f0 in zip(repos, frames0)
+        )
+        # O(fanout) with relay + sweep slack — O(peers) would be >= 49
+        assert amp <= 4 * fanout + 8, amp
+    finally:
+        for r in repos:
+            r.close()
+        for sw in swarms:
+            sw.destroy()
+        boot.close()

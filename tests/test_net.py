@@ -562,6 +562,46 @@ class TestJoinOptions:
         ra.close()
         rb.close()
 
+    def test_leave_cancels_pending_join(self):
+        """Regression: a leave racing a join used to strand a member
+        entry. join() records intent (`joined.add`) then registers at
+        the hub; with a leave interleaved between the two steps, the
+        late hub registration must cancel itself (LoopbackHub.join
+        re-checks `joined` inside the hub lock) instead of leaving a
+        departed swarm paired forever."""
+        from hypermerge_tpu.net.swarm import DEFAULT_JOIN
+
+        hub = LoopbackHub()
+        s = LoopbackSwarm(hub)
+        did = "race-doc"
+        # the racy interleave, step by step: join's first half...
+        s.joined.add(did)
+        # ...a concurrent leave runs completely...
+        s.leave(did)
+        # ...then join's second half (the hub registration) lands late
+        hub.join(s, did, DEFAULT_JOIN)
+        assert not hub._members.get(did), "leave left a member behind"
+        # and a member entry stranded this way would actually pair: a
+        # fresh looker-up must NOT connect to the departed swarm
+        other = LoopbackSwarm(hub)
+        got = []
+        other.on_connection(lambda d, det: got.append(d))
+        other.join(did)
+        assert not got and not other.connected
+
+    def test_leave_then_rejoin_still_pairs(self):
+        """The leave fix must not eat a genuine re-join."""
+        hub = LoopbackHub()
+        sa, sb = LoopbackSwarm(hub), LoopbackSwarm(hub)
+        conns = []
+        sa.on_connection(lambda d, det: conns.append(d))
+        sb.on_connection(lambda d, det: conns.append(d))
+        sa.join("doc")
+        sa.leave("doc")
+        sa.join("doc")
+        sb.join("doc")
+        assert conns and sa.connected
+
     def test_default_join_is_symmetric(self):
         hub = LoopbackHub()
         ra, rb = Repo(memory=True), Repo(memory=True)

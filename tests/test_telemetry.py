@@ -315,7 +315,10 @@ def test_replication_stats_shape_and_race_closed():
     rm = ReplicationManager(feeds=None, on_discovery=lambda *a: None)
     try:
         assert set(rm.stats) == {
-            "resyncs", "t_resync_ms", "antientropy_sweeps"
+            "resyncs", "t_resync_ms", "antientropy_sweeps",
+            # round 19: wire frame counters exposed for the fleet
+            # bench's per-peer frame-amplification measurement
+            "frames_tx", "frames_rx",
         }
         # the exact race the migration closes: t_resync_ms += from
         # many reader threads at once

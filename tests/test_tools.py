@@ -373,6 +373,111 @@ def test_top_over_ipc_seam(tmp_path):
     assert not t.is_alive()
 
 
+def test_meta_dht_probe():
+    """tools/meta.py --dht boots an ephemeral node, bootstraps off the
+    fleet, and reports node id + bucket occupancy — the from-outside
+    'is the DHT reachable' probe."""
+    from hypermerge_tpu.net.discovery import DhtNode
+
+    a = DhtNode()
+    b = DhtNode(bootstrap=[a.address])
+    try:
+        b.bootstrap_now()
+        out = _run([
+            "tools/meta.py", "--dht",
+            "--bootstrap", f"127.0.0.1:{a.address[1]}",
+        ])
+        assert out.returncode == 0, out.stderr
+        probe = json.loads(out.stdout.strip())
+        assert len(probe["node_id"]) == 40
+        assert probe["nodes"] >= 1
+        assert probe["buckets"]  # at least one occupied bucket
+    finally:
+        a.close()
+        b.close()
+
+
+def test_meta_dht_probe_unreachable_exits_nonzero():
+    from hypermerge_tpu.net.discovery import DhtNode
+
+    dead = DhtNode()
+    port = dead.address[1]
+    dead.close()
+    out = subprocess.run(
+        [
+            sys.executable, "tools/meta.py", "--dht",
+            "--bootstrap", f"127.0.0.1:{port}",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**ENV, "HM_DHT_RPC_TIMEOUT_S": "0.2"},
+        cwd=REPO_ROOT,
+    )
+    assert out.returncode == 1
+    assert json.loads(out.stdout.strip())["nodes"] == 0
+
+
+def test_ipc_dht_daemon_and_ls_swarm_columns(tmp_path, monkeypatch):
+    """A net/ipc.py daemon joined via --dht replicates with a fleet
+    peer discovered through announce/lookup only, and tools/ls.py
+    --sock renders the dht: header plus the peers=/announce= columns
+    from the daemon's Telemetry payload."""
+    import threading
+
+    from hypermerge_tpu.net.discovery import DhtNode, DhtSwarm
+    from hypermerge_tpu.net.ipc import serve_backend
+
+    monkeypatch.setenv("HM_DHT_ANNOUNCE_S", "0.2")
+    monkeypatch.setenv("HM_DHT_LOOKUP_S", "0.2")
+    monkeypatch.setenv("HM_NET_PING_S", "0")
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    url = repo.create({"fleet": True})
+    repo.close()
+
+    boot = DhtNode()
+    sock = str(tmp_path / "b.sock")
+    t = threading.Thread(
+        target=serve_backend,
+        args=(sock,),
+        kwargs=dict(
+            repo_path=path, once=True, dht=True,
+            dht_bootstrap=[f"127.0.0.1:{boot.address[1]}"],
+        ),
+        daemon=True,
+    )
+    t.start()
+    for _ in range(200):
+        if os.path.exists(sock):
+            break
+        time.sleep(0.05)
+
+    peer = Repo(memory=True)
+    sw = DhtSwarm(bootstrap=[boot.address])
+    peer.set_swarm(sw)
+    try:
+        # pure-DHT discovery: the peer finds the daemon via lookup
+        assert peer.open(url).value(timeout=60) is not None
+        out = subprocess.run(
+            [sys.executable, "tools/ls.py", path, "--sock", sock],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**ENV, "HM_RECOVER": "0"},
+            cwd=REPO_ROOT,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "dht: node" in out.stdout
+        assert "announce=yes" in out.stdout
+        assert "peers=1" in out.stdout
+    finally:
+        peer.close()
+        sw.destroy()
+        boot.close()
+        t.join(20)
+
+
 def test_meta_stats_snapshot(tmp_path):
     path = str(tmp_path / "repo")
     repo = Repo(path=path)

@@ -17,6 +17,14 @@ a RUNNING daemon's query socket (`tools/serve.py --ipc <sock>` or
 `net/ipc.py`) to list the residency the daemon is actually serving
 from.
 
+The `peers=`/`announce=` columns (shown when the backend has a swarm)
+come from the Telemetry payload's `net` block: how many connected
+peers replicate each doc right now, and whether the doc's feeds are
+joined for discovery (announced/looked-up). Against a DHT-discovered
+daemon (net/discovery/ DhtSwarm) a `dht:` header line adds the node
+id, routing-table size, and stored announce-record count — the same
+block `tools/meta.py --dht` probes from outside.
+
 The `scrub=` column surfaces crash damage without a full scrub
 (storage/scrub.py doc_status): `ok`, `recovered` (the last crash
 recovery repaired something for this doc's feeds — torn tails,
@@ -134,13 +142,32 @@ def main() -> None:
         spec.loader.exec_module(top)
         client = top.IpcTelemetry(args.sock)
         try:
-            serve = client.poll().get("serve")
+            payload = client.poll()
         finally:
             client.close()
     else:
         tq = []
         repo.telemetry(tq.append)
-        serve = (tq[0] or {}).get("serve") if tq else None
+        payload = (tq[0] or {}) if tq else {}
+    serve = payload.get("serve")
+    net = (payload.get("net") or {}).get("docs", {})
+    dht = payload.get("dht")
+    if dht is not None:
+        # DHT-discovered daemon: one header line of swarm truth (the
+        # per-doc peers=/announce= columns below come from the same
+        # payload)
+        print(
+            f"dht: node {dht['node_id'][:12]}… "
+            f"nodes={dht['nodes']} records={dht['records']} "
+            f"joined={len(dht['joined'])}"
+        )
+
+    def swarm_cols(doc_id):
+        ent = net.get(doc_id)
+        if ent is None:
+            return ""
+        ann = "yes" if ent.get("announced") else "no"
+        return f"peers={ent.get('peers', 0)} announce={ann} "
 
     def residency(doc_id):
         if serve is None:
@@ -160,6 +187,7 @@ def main() -> None:
         line = (
             f"{to_doc_url(doc_id)}  actors={len(cursor)} "
             f"changes={total_changes} bytes={nbytes} "
+            f"{swarm_cols(doc_id)}"
             f"residency={residency(doc_id)} "
             f"scrub={doc_status(back, doc_id, report)} "
             f"wal={wal_status(report, cursor)}"

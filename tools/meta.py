@@ -6,6 +6,7 @@ tools/Meta.ts — `repo.meta(url, cb)` surfaced on the command line).
     python tools/meta.py /path/to/repo 'hyperfile:/<fileId>'
     python tools/meta.py --devices
     python tools/meta.py /path/to/repo --stats
+    python tools/meta.py --dht [--bootstrap host:port,host:port]
 
 Output is one JSON object. Documents are opened first (metadata queries
 answer from the open doc's backend state); unknown urls print null and
@@ -16,6 +17,13 @@ needed): device count, platform/kind, (dp, sp) mesh shape, and whether
 the Pallas ICI remote-copy path is live — the same object the bench
 embeds as `multichip_topology`, so a bench JSON line is auditable
 against the box it ran on.
+
+`--dht` probes a running DHT fleet from outside: boots an EPHEMERAL
+node (net/discovery/dht.py), bootstraps it from `--bootstrap` or
+`HM_DHT_BOOTSTRAP`, walks toward its own id, and prints the node id
+and per-bucket occupancy JSON — "is the fleet reachable and how big
+does it look from here" in one command. `nodes` is the routing-table
+size after the walk; an empty table means no bootstrap answered.
 
 `--stats` opens the repo (and its docs) and prints the process-wide
 telemetry snapshot JSON — the registry every subsystem now reports
@@ -55,8 +63,40 @@ def main() -> None:
         "--stats", action="store_true",
         help="open the repo and print the telemetry registry snapshot",
     )
+    ap.add_argument(
+        "--dht", action="store_true",
+        help="probe the DHT fleet with an ephemeral node and print "
+        "node id + bucket occupancy JSON",
+    )
+    ap.add_argument(
+        "--bootstrap", default=None,
+        help="host:port[,host:port] DHT bootstrap list for --dht "
+        "(default: HM_DHT_BOOTSTRAP)",
+    )
     args = ap.parse_args()
 
+    if args.dht:
+        from hypermerge_tpu.net.discovery import DhtNode
+
+        bootstrap = None
+        if args.bootstrap:
+            bootstrap = []
+            for part in args.bootstrap.split(","):
+                host, _, port = part.strip().rpartition(":")
+                bootstrap.append((host, int(port)))
+        node = DhtNode(bootstrap=bootstrap)
+        try:
+            node.bootstrap_now()
+            print(json.dumps({
+                "node_id": node.id_hex,
+                "dht_address": list(node.address),
+                "nodes": node.table.size(),
+                "buckets": node.table.occupancy(),
+                "records": node.records.size(),
+            }, sort_keys=True), flush=True)
+            sys.exit(0 if node.table.size() else 1)
+        finally:
+            node.close()
     if args.devices:
         from hypermerge_tpu.parallel.mesh import device_topology
 

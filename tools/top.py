@@ -13,6 +13,15 @@ ISSUE 14 the ``[wal]`` group renders the group-commit journal's
 window claim as a live ratio), checkpoints/s, journal bytes/s, and
 replayed blocks (recovery).
 
+Since ISSUE 15 DHT-discovered daemons (net/discovery/) show the
+``[dht]`` group — announce/lookup/RPC rates plus ``dht.lookup_hops``
+(hops/lookup = ``lookup_hops`` rate over ``lookups`` rate) and
+``dht.stale_evictions`` (k-bucket liveness churn) — and the
+``[gossip]`` group: ``gossip.sent`` vs ``gossip.suppressed`` is the
+bounded-fanout claim as a live ratio (suppressed counts the peers the
+``HM_GOSSIP_FANOUT`` cap skipped per broadcast; anti-entropy sweeps
+never appear here because they are deliberately unsampled).
+
 Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
 the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
 per-lock-class blocking-debt series whose ``live_engine`` row is the
