@@ -137,6 +137,22 @@ REGISTRY: Tuple[EnvVar, ...] = (
     EnvVar("HM_SERVE_QUEUE", "4096", "Bound of the read admission "
            "queue; overflowing reads degrade to the host path "
            "(serve.fallbacks) instead of queueing unboundedly."),
+    # -- write plane (hub daemon) ---------------------------------------
+    EnvVar("HM_NATIVE_CODEC", "1", "Binary change frames (native "
+           "GIL-free encode when built, bit-identical Python twin "
+           "otherwise) for small change blocks; 0 = write JSON blocks "
+           "(readers always handle both)."),
+    EnvVar("HM_HUB_WRITERS", "1", "Hub daemon many-writer plane: tag "
+           "Create/Open/NeedsActorId with the connection key so each "
+           "writing connection gets its OWN per-doc actor; 0 = legacy "
+           "one-writer-per-doc protocol."),
+    EnvVar("HM_WORKERS", "0", "Hub daemon worker processes: >0 shards "
+           "docs across N per-doc-range net.ipc worker subprocesses "
+           "(own repo shard, engine, and WAL each) behind the hub; "
+           "0 = single in-process backend."),
+    EnvVar("HM_WORKER_RESPAWN_MS", "200", "Supervision backoff before "
+           "a dead worker process is reaped and respawned on its "
+           "shard (journal-prefix recovery replays acked edits)."),
     # -- network --------------------------------------------------------
     EnvVar("HM_DHT_BOOTSTRAP", None, "Comma-separated host:port DHT "
            "bootstrap nodes (net/discovery/) for DhtSwarm/DhtNode."),

@@ -137,7 +137,8 @@ GUARDS: Tuple[GuardedClass, ...] = (
     ),
     GuardedClass(
         "RepoBackend", "hypermerge_tpu.backend.repo_backend", "repo",
-        guarded=("_bulk_deferred_syncs", "_bulk_feed_rows"),
+        guarded=("_bulk_deferred_syncs", "_bulk_feed_rows",
+                 "_writer_actors", "_pending_ready"),
         atomic_read_ok=("docs", "actors"),
         init_only=(
             "path", "memory", "durability", "db", "clocks", "cursors",
@@ -277,12 +278,26 @@ GUARDS: Tuple[GuardedClass, ...] = (
     GuardedClass(
         "_FrontendHub", "hypermerge_tpu.net.ipc", "net.ipc.hub",
         guarded=("_conns", "_interest", "_next_key"),
-        init_only=("_back",),
+        init_only=("_back", "_writers"),
         doc="The multi-frontend daemon's connection + doc-interest "
             "tables (accept/reader threads register and retire "
             "entries, the to_frontend router snapshots its targets) "
             "mutate under net.ipc.hub; socket sends run OUTSIDE it "
             "so a slow frontend cannot stall accepts or routing.",
+    ),
+    GuardedClass(
+        "_ShardRouter", "hypermerge_tpu.net.ipc", "net.ipc.router",
+        guarded=("_workers", "_pending", "_respawns", "_gen",
+                 "_tele", "_next_tele"),
+        init_only=("_repo_path", "_sock_base", "_n"),
+        unguarded=("_closed", "_dispatch", "_interest"),
+        doc="Worker slots, outage buffers, and in-flight telemetry "
+            "fan-outs mutate under net.ipc.router (route threads vs "
+            "the respawn supervisor vs worker reader threads); "
+            "socket sends run OUTSIDE it. `_closed` is a monotonic "
+            "shutdown latch; `_dispatch`/`_interest` are set-once "
+            "hub wiring installed by start() before any worker "
+            "spawns (traffic cannot precede them).",
     ),
     GuardedClass(
         "FileFeedStorage", "hypermerge_tpu.storage.feed",

@@ -267,6 +267,12 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "connection/interest table (accept threads vs route).",
     ),
     LockClass(
+        "net.ipc.router", None,
+        "net.ipc._ShardRouter._lock — the HM_WORKERS write plane's "
+        "worker-slot/pending/telemetry tables (route threads vs the "
+        "respawn supervisor vs worker reader threads).",
+    ),
+    LockClass(
         "pipeline.err", None,
         "pipeline FetchContext._err_lock — first-error capture.",
     ),

@@ -108,7 +108,7 @@ class Actor:
                 return
             self.changes.append(change)
             try:
-                self.feed.append(blockmod.pack(change.to_json()))
+                self.feed.append(blockmod.pack_change(change.to_json()))
             except BaseException:
                 # ENOSPC/EIO mid-append: if the block never landed on
                 # the feed (storage only advances on success), the

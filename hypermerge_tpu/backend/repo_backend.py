@@ -223,6 +223,13 @@ class RepoBackend:
         self.docs: Dict[str, DocBackend] = {}
         self.actors: Dict[str, Actor] = {}
         self._lock = make_rlock("repo")
+        # many-writer plane (hub mode): Create/Open/NeedsActorId arrive
+        # tagged with a per-connection writer token; each writing
+        # connection gets its OWN actor per doc so N frontends can write
+        # one hot doc without sharing (and corrupting) a seq counter.
+        # (doc_id, token) -> actor_id; doc_id -> tokens awaiting Ready.
+        self._writer_actors: Dict[Any, str] = {}
+        self._pending_ready: Dict[str, set] = {}
         self.to_frontend: Queue = Queue("backend:toFrontend")
         self._query_handlers: Dict[str, Callable] = {}
         self.network = None  # attached by setSwarm (net/, M7)
@@ -412,9 +419,12 @@ class RepoBackend:
             return
         t = msg["type"]
         if t == "Create":
-            self.create(msg["publicKey"], msg["secretKey"])
+            self.create(
+                msg["publicKey"], msg["secretKey"],
+                writer=msg.get("writer"),
+            )
         elif t == "Open":
-            self.open(msg["id"])
+            self.open(msg["id"], writer=msg.get("writer"))
         elif t == "OpenBulk":
             self.load_documents_bulk(msg["ids"])
         elif t == "Request":
@@ -432,24 +442,45 @@ class RepoBackend:
         elif t == "NeedsActorId":
             doc = self.docs.get(msg["id"])
             if doc is not None:
-                self._ensure_writable_actor(doc)
+                writer = msg.get("writer")
+                if writer is None:
+                    self._ensure_writable_actor(doc)
+                else:
+                    self._grant_writer_actor(doc, writer)
+        elif t == "WriterGone":
+            self._drop_writer(msg["writer"])
         else:
             log("repo:backend", "unknown msg", t)
 
     # ------------------------------------------------------------------
     # doc lifecycle
 
-    def create(self, public_key: str, secret_key: str) -> DocBackend:
+    def create(
+        self,
+        public_key: str,
+        secret_key: str,
+        writer: Optional[int] = None,
+    ) -> DocBackend:
         doc_id = public_key
         doc = DocBackend(doc_id, self._doc_notify, None, live=self.live)
         with self._lock:
             self.docs[doc_id] = doc
+            if writer is not None:
+                # the creating connection claims the root actor (its
+                # frontend already assumed actor_id == doc_id); later
+                # writers mint fresh actors via NeedsActorId
+                self._writer_actors[(doc_id, writer)] = root_actor_id(
+                    doc_id
+                )
+                self._pending_ready.setdefault(doc_id, set()).add(writer)
         self.cursors.add_actor(self.id, doc_id, root_actor_id(doc_id))
         self._init_actor(keymod.KeyPair(public_key, secret_key))
         doc.init([], doc_id)  # root actor is writable on create
         return doc
 
-    def open(self, doc_id: str) -> DocBackend:
+    def open(
+        self, doc_id: str, writer: Optional[int] = None
+    ) -> DocBackend:
         with self._lock:
             doc = self.docs.get(doc_id)
             if doc is None:
@@ -460,6 +491,12 @@ class RepoBackend:
                 existing = None
             else:
                 existing = doc
+            if writer is not None and (
+                existing is None or not existing._announced
+            ):
+                # doc still loading: park the token; the DocReady-time
+                # _send_ready pops it and emits this writer's Ready
+                self._pending_ready.setdefault(doc_id, set()).add(writer)
         if existing is not None:
             if existing._announced:
                 # a (re)opened frontend needs the Ready snapshot again.
@@ -470,7 +507,7 @@ class RepoBackend:
                 # would deadlock against a tick. The lint rule
                 # `lock-order` flags engine entrypoints called under
                 # repo/doc/store locks.
-                self._send_ready(existing)
+                self._send_ready(existing, writer=writer)
             return existing
         try:
             # a doc closed with store rows still in the debouncer must
@@ -506,6 +543,11 @@ class RepoBackend:
     def close_doc(self, doc_id: str) -> None:
         with self._lock:
             self.docs.pop(doc_id, None)
+            self._pending_ready.pop(doc_id, None)
+            for key in [
+                k for k in self._writer_actors if k[0] == doc_id
+            ]:
+                del self._writer_actors[key]
         if self.live is not None:
             self.live.drop(doc_id)
         if self.serve is not None:
@@ -1597,6 +1639,41 @@ class RepoBackend:
         actor_id = self._writable_actor_for(doc.id)
         doc.set_actor_id(actor_id)
 
+    def _grant_writer_actor(self, doc: DocBackend, writer: int) -> None:
+        """Many-writer NeedsActorId: mint ONE fresh actor per writing
+        connection (never claim an existing writable actor — after a
+        worker respawn a reconnecting frontend may still be appending
+        to it) and answer only that connection with a tagged ActorId.
+        Does NOT call doc.set_actor_id — that fires an UNTAGGED
+        broadcast ActorId event which every connection's frontend
+        would adopt."""
+        with self._lock:
+            actor_id = self._writer_actors.get((doc.id, writer))
+        if actor_id is None:
+            minted = self._create_doc_actor(doc.id)
+            with self._lock:
+                # first mint wins a NeedsActorId race for the same
+                # token; the loser's fresh actor stays registered but
+                # unused (frontends send one NeedsActorId per doc)
+                actor_id = self._writer_actors.setdefault(
+                    (doc.id, writer), minted
+                )
+        msg = msgs.actor_id_msg(doc.id, actor_id)
+        msg["writer"] = writer
+        self.to_frontend.push(msg)
+
+    def _drop_writer(self, writer: int) -> None:
+        """A writing connection went away (hub detach): forget its
+        per-doc actor grants and any parked Ready tokens. The actors
+        themselves stay — their feeds hold acked history."""
+        with self._lock:
+            for key in [
+                k for k in self._writer_actors if k[1] == writer
+            ]:
+                del self._writer_actors[key]
+            for tokens in self._pending_ready.values():
+                tokens.discard(writer)
+
     # ------------------------------------------------------------------
     # actors
 
@@ -1862,14 +1939,41 @@ class RepoBackend:
                 msgs.actor_id_msg(doc.id, event["actorId"])
             )
 
-    def _send_ready(self, doc: DocBackend) -> None:
+    def _send_ready(
+        self, doc: DocBackend, writer: Optional[int] = None
+    ) -> None:
         def push(patch) -> None:
             self._mark_clock_row(doc)
+            patch_json = patch.to_json() if patch else None
+            # many-writer plane: serve every parked writer token (plus
+            # the direct re-opener) a PER-CONNECTION Ready carrying the
+            # actor granted to THAT connection (None -> the frontend
+            # opens read-mode and mints via NeedsActorId on first
+            # write). Rank-legal under doc.emission: doc.emit ranks
+            # below repo in analysis/hierarchy.py.
+            with self._lock:
+                tokens = self._pending_ready.pop(doc.id, set())
+                if writer is not None:
+                    tokens.add(writer)
+                grants = {
+                    t: self._writer_actors.get((doc.id, t))
+                    for t in tokens
+                }
+            for token, actor_id in sorted(grants.items()):
+                msg = msgs.ready_msg(
+                    doc.id, actor_id, patch_json, doc.history_len
+                )
+                msg["writer"] = token
+                self.to_frontend.push(msg)
+            if tokens:
+                # tagged mode: an extra UNTAGGED Ready would broadcast
+                # doc.actor_id to every connection (actor collision)
+                return
             self.to_frontend.push(
                 msgs.ready_msg(
                     doc.id,
                     doc.actor_id,
-                    patch.to_json() if patch else None,
+                    patch_json,
                     doc.history_len,
                 )
             )
@@ -1891,7 +1995,7 @@ class RepoBackend:
         from . import emission
 
         if emission.entered_other(doc.id):
-            emission.defer(lambda: self._send_ready(doc))
+            emission.defer(lambda: self._send_ready(doc, writer=writer))
             return
         with doc.emission:
             if self.live is not None:

@@ -121,6 +121,22 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib._has_pack = True
     except AttributeError:
         lib._has_pack = False
+    # change-frame codec entry points are OPTIONAL for the same
+    # prebuilt-.so reason; same GIL contract as the pack entries
+    # (caller-owned buffers only — pinned by codec_drops_gil()).
+    try:
+        buf = ctypes.c_char_p
+        lib.hm_change_encode.restype = ctypes.c_long
+        lib.hm_change_encode.argtypes = [
+            buf, ctypes.c_size_t, buf, ctypes.c_size_t,
+        ]
+        lib.hm_change_decode.restype = ctypes.c_long
+        lib.hm_change_decode.argtypes = [
+            buf, ctypes.c_size_t, buf, ctypes.c_size_t,
+        ]
+        lib._has_codec = True
+    except AttributeError:
+        lib._has_codec = False
     return lib
 
 
@@ -172,6 +188,60 @@ def pack_drops_gil() -> bool:
     GIL; we never load through it.)"""
     lib = pack_lib()
     return lib is not None and not isinstance(lib, ctypes.PyDLL)
+
+
+def codec_lib() -> Optional[ctypes.CDLL]:
+    """The library handle iff it carries the change-frame codec entry
+    points (crdt/codec.py native fast path); None otherwise."""
+    lib = load()
+    if lib is None or not getattr(lib, "_has_codec", False):
+        return None
+    return lib
+
+
+def codec_drops_gil() -> bool:
+    """True when the change-codec entry points are bound through a
+    plain ctypes.CDLL, whose foreign calls release the GIL — the
+    property the sharded write daemon relies on to parse frames from N
+    connections on real threads. (ctypes.PyDLL would hold the GIL; we
+    never load through it.)"""
+    lib = codec_lib()
+    return lib is not None and not isinstance(lib, ctypes.PyDLL)
+
+
+def _codec_call(fn, data: bytes, guess: int) -> Optional[bytes]:
+    """Counting-writer protocol shared by encode/decode: the entry
+    point always returns the size it NEEDS and only writes what fits
+    in cap, so one retry with the returned size always lands."""
+    out = ctypes.create_string_buffer(guess)
+    n = fn(data, len(data), out, guess)
+    if n < 0:
+        return None
+    if n > guess:
+        out = ctypes.create_string_buffer(n)
+        n = fn(data, len(data), out, n)
+        if n < 0 or n > len(out):
+            return None
+    return out.raw[:n]
+
+
+def change_encode(raw: bytes) -> Optional[bytes]:
+    """Canonical change JSON -> binary change frame; None when the
+    native layer is absent or the input is off-canon (caller falls
+    back to the Python twin / raw JSON block)."""
+    lib = codec_lib()
+    if lib is None:
+        return None
+    return _codec_call(lib.hm_change_encode, raw, len(raw) + 16)
+
+
+def change_decode(frame: bytes) -> Optional[bytes]:
+    """Binary change frame -> canonical change JSON; None when the
+    native layer is absent or the frame is malformed."""
+    lib = codec_lib()
+    if lib is None:
+        return None
+    return _codec_call(lib.hm_change_decode, frame, 2 * len(frame) + 64)
 
 
 def available() -> bool:

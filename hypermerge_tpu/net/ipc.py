@@ -23,10 +23,14 @@ backend; the frontend process needs none of them loaded.
 
 from __future__ import annotations
 
+import hashlib
 import os
 import socket
+import subprocess
+import sys
 import threading
-from typing import Callable, Dict, Optional, Set, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ..analysis.lockdep import make_lock
 from .tcp import TcpDuplex
@@ -111,18 +115,24 @@ class _FrontendHub:
     (Open/Create/Request/...) receives that doc's pushes, and
     disjoint-doc writers never see each other's patch traffic; Close/
     Destroy retires the interest. Un-addressed pushes broadcast.
-    Supported write topology: ONE writing frontend per doc (any number
-    of watchers) — the backend grants one writable actor per doc, so
-    two connections editing the same doc would collide on its seq
-    counter. Concurrent same-doc writers belong on separate daemons
-    joined by replication (the reference design); hub mode's
-    concurrency win is disjoint docs.
+    Write topology: MANY writing frontends per doc. Create/Open/
+    NeedsActorId are tagged with the connection key (`writer`), and the
+    backend mints one actor PER WRITING CONNECTION (repo_backend
+    `_grant_writer_actor`), so concurrent same-doc writers never share
+    a seq counter. Ready/ActorId replies carrying a `writer` tag route
+    ONLY to that connection (tag stripped); Patch traffic stays
+    interest-broadcast — every connection converges through the
+    backend's emission-ordered patch stream. HM_HUB_WRITERS=0 reverts
+    to the legacy one-writer-per-doc tagging-free protocol.
     Socket sends run OUTSIDE the hub lock (`net.ipc.hub`,
     analysis/hierarchy.py): a slow frontend must not stall accepts or
     another connection's teardown."""
 
     def __init__(self, back) -> None:
         self._back = back
+        self._writers = (
+            os.environ.get("HM_HUB_WRITERS", "1") != "0"
+        )
         self._lock = make_lock("net.ipc.hub")
         self._conns: Dict[int, TcpDuplex] = {}
         self._interest: Dict[str, Set[int]] = {}  # doc id -> conn keys
@@ -150,6 +160,19 @@ class _FrontendHub:
                     emptied.append(doc_id)
             for doc_id in emptied:
                 del self._interest[doc_id]
+        if self._writers:
+            # the backend forgets the gone connection's per-doc actor
+            # grants (a long-lived daemon must not leak one map entry
+            # per connection ever accepted). Outside the hub lock: the
+            # backend takes its own locks.
+            self._back.receive({"type": "WriterGone", "writer": key})
+
+    def snapshot_interest(self):
+        """Doc ids any live connection currently watches — the shard
+        router's respawn replay set (a revived worker re-Opens these so
+        its docs announce and resume patch pushes)."""
+        with self._lock:
+            return list(self._interest.keys())
 
     def _inbound(self, key: int, msg) -> None:
         if isinstance(msg, dict):
@@ -173,6 +196,14 @@ class _FrontendHub:
             if t == "Query":
                 msg = dict(msg)
                 msg["queryId"] = [key, msg["queryId"]]
+            elif self._writers and t in (
+                "Create", "Open", "NeedsActorId"
+            ):
+                # many-writer plane: the backend grants this CONNECTION
+                # its own actor per doc and routes the tagged Ready/
+                # ActorId back here only
+                msg = dict(msg)
+                msg["writer"] = key
         self._back.receive(msg)
 
     def dispatch(self, msg) -> None:
@@ -189,6 +220,19 @@ class _FrontendHub:
                 if duplex is not None:
                     out = dict(msg)
                     out["queryId"] = qid[1]
+                    self._send(duplex, out)
+                return
+            writer = msg.get("writer")
+            if writer is not None:
+                # per-connection push (tagged Ready/ActorId): ONLY the
+                # connection it was minted for sees it. writer == -1 is
+                # the respawn-replay sentinel (routes to nobody — the
+                # Open existed to re-announce the doc in the worker).
+                with self._lock:
+                    duplex = self._conns.get(writer)
+                if duplex is not None:
+                    out = dict(msg)
+                    del out["writer"]
                     self._send(duplex, out)
                 return
             doc_id = msg.get("id")
@@ -213,6 +257,378 @@ class _FrontendHub:
             duplex.send(msg)
         except OSError:
             pass  # the duplex's on_close detach reaps the connection
+
+
+def _shard_of(doc_id: str, n: int) -> int:
+    """Stable doc-id -> worker shard (sha1 prefix mod n): every process
+    — hub, tests, tools — computes the same owner for a doc."""
+    digest = hashlib.sha1(
+        doc_id.encode("utf-8", "surrogatepass")
+    ).hexdigest()
+    return int(digest[:8], 16) % n
+
+
+class _ShardRouter:
+    """HM_WORKERS per-doc-range worker PROCESSES behind one hub — the
+    GIL-free write plane. The hub-facing surface is a RepoBackend
+    stand-in (`receive`/`close`); behind it, doc-addressed messages
+    route by `_shard_of(doc_id)` to a worker subprocess (a plain
+    once-mode `net.ipc` daemon owning `<repo>/shard-<k>` — its OWN
+    engine, feeds, and WAL) over the same framed duplex frontends use.
+    Worker ReplyFence tagging nests queryIds transparently.
+
+    Telemetry Queries fan out to every worker and merge (counters sum,
+    time is the max, per-worker `workers.<i>.*` gauges are injected);
+    a dead worker is covered by a timeout so `tools/top.py` never
+    hangs on a crash window.
+
+    Worker death (duplex close) is SUPERVISED: after
+    HM_WORKER_RESPAWN_MS the old process is reaped, a fresh one is
+    spawned on the same shard repo + socket, the hub's live interest
+    set is replayed as `writer=-1` Opens (re-announce without waking
+    any frontend), and messages buffered during the outage flush. The
+    revived worker's own crash recovery (dirty marker + WAL journal
+    prefix) restores every acked edit; persisted actor keys keep the
+    reconnecting frontends' actors writable. An unacked in-flight
+    request dies with the worker — exactly the pre-ack loss crash
+    semantics the WAL tests pin.
+    """
+
+    def __init__(
+        self,
+        repo_path: Optional[str],
+        sock_base: str,
+        n_workers: int,
+    ) -> None:
+        self._repo_path = repo_path
+        self._sock_base = sock_base
+        self._n = n_workers
+        self._lock = make_lock("net.ipc.router")
+        self._workers: List[Optional[Dict[str, Any]]] = [None] * n_workers
+        self._pending: List[List[Any]] = [[] for _ in range(n_workers)]
+        self._respawns = [0] * n_workers
+        self._gen = 0
+        self._tele: Dict[int, Dict[str, Any]] = {}
+        self._next_tele = 0
+        self._closed = False
+        # set-once wiring, installed by start() BEFORE workers spawn
+        self._dispatch: Callable[[Any], None] = lambda _msg: None
+        self._interest: Callable[[], list] = lambda: []
+        if repo_path is not None:
+            os.makedirs(repo_path, exist_ok=True)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, dispatch, snapshot_interest) -> None:
+        """Wire the hub sinks, then bring up every worker (order
+        matters: a worker's first push must find dispatch installed)."""
+        self._dispatch = dispatch
+        self._interest = snapshot_interest
+        for i in range(self._n):
+            pid = self._spawn(i)
+            print(f"worker {i} pid {pid}", flush=True)
+
+    def _shard_repo(self, i: int) -> str:
+        if self._repo_path is None:
+            return ":memory:"
+        return os.path.join(self._repo_path, f"shard-{i}")
+
+    def _spawn(self, i: int) -> int:
+        """Start worker i and connect to it (retried: the worker binds
+        its socket only after its interpreter + backend imports)."""
+        wsock = f"{self._sock_base}.w{i}"
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "hypermerge_tpu.net.ipc",
+                self._shard_repo(i),
+                wsock,
+            ],
+            stdout=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + 120.0
+        while True:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {i} died on startup "
+                    f"(rc={proc.returncode})"
+                )
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError(f"worker {i} never bound {wsock}")
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(wsock)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            duplex = TcpDuplex(s, is_client=True)
+            if duplex.closed:  # bind/handshake race: try again
+                time.sleep(0.05)
+                continue
+            break
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+            self._workers[i] = {
+                "proc": proc,
+                "duplex": duplex,
+                "gen": gen,
+                "pid": proc.pid,
+            }
+        duplex.on_message(lambda msg, _i=i: self._from_worker(_i, msg))
+        duplex.on_close(lambda _i=i, _g=gen: self._worker_gone(_i, _g))
+        return proc.pid
+
+    def _worker_gone(self, i: int, gen: int) -> None:
+        with self._lock:
+            slot = self._workers[i]
+            if self._closed or slot is None or slot["gen"] != gen:
+                return  # shutdown, or a respawn already superseded it
+        threading.Thread(
+            target=self._respawn, args=(i, gen), daemon=True
+        ).start()
+
+    def _respawn(self, i: int, gen: int) -> None:
+        time.sleep(
+            float(os.environ.get("HM_WORKER_RESPAWN_MS", "200")) / 1e3
+        )
+        with self._lock:
+            slot = self._workers[i]
+            if self._closed or slot is None or slot["gen"] != gen:
+                return
+        try:
+            slot["proc"].kill()
+            slot["proc"].wait(10)
+        except OSError:
+            pass
+        try:
+            pid = self._spawn(i)
+        except RuntimeError:
+            with self._lock:  # crash loop: leave the slot for close()
+                if not self._closed:
+                    self._workers[i] = None
+            return
+        with self._lock:
+            self._respawns[i] += 1
+            flush = list(self._pending[i])
+            del self._pending[i][:]
+        # re-announce the shard's live docs (writer=-1: the tagged
+        # Readys route to nobody; frontends already initialized) so
+        # journal-prefix recovery materializes them and patch pushes
+        # resume, THEN release anything buffered during the outage
+        for doc_id in self._interest():
+            if _shard_of(doc_id, self._n) == i:
+                self._send_to(
+                    i, {"type": "Open", "id": doc_id, "writer": -1}
+                )
+        for msg in flush:
+            self._send_to(i, msg)
+        print(f"worker {i} pid {pid} respawned", flush=True)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            slots = [w for w in self._workers if w is not None]
+        for w in slots:
+            try:
+                w["duplex"].close()
+            except OSError:
+                pass
+            w["proc"].terminate()
+        for w in slots:
+            try:
+                w["proc"].wait(10)
+            except subprocess.TimeoutExpired:
+                w["proc"].kill()
+                w["proc"].wait(10)
+        for i in range(self._n):
+            wsock = f"{self._sock_base}.w{i}"
+            if os.path.exists(wsock):
+                os.remove(wsock)
+
+    # -- hub-facing backend surface ------------------------------------
+
+    def receive(self, msg) -> None:
+        if not isinstance(msg, dict):
+            return
+        t = msg.get("type")
+        if t == "Query":
+            query = msg.get("query")
+            qtype = (
+                query.get("type") if isinstance(query, dict) else None
+            )
+            if qtype == "Telemetry":
+                self._telemetry_fanout(msg)
+                return
+            doc_id = (
+                query.get("id") if isinstance(query, dict) else None
+            )
+            if doc_id is not None:
+                self._send_to(_shard_of(doc_id, self._n), msg)
+                return
+        elif t == "OpenBulk":
+            buckets: Dict[int, list] = {}
+            for doc_id in msg.get("ids", ()):
+                buckets.setdefault(
+                    _shard_of(doc_id, self._n), []
+                ).append(doc_id)
+            for i, ids in buckets.items():
+                self._send_to(i, {**msg, "ids": ids})
+            return
+        else:
+            doc_id = (
+                msg.get("publicKey") if t == "Create" else msg.get("id")
+            )
+            if doc_id is not None:
+                self._send_to(_shard_of(doc_id, self._n), msg)
+                return
+        # not doc-addressed (WriterGone, unkeyed queries, ...): every
+        # worker gets it
+        for i in range(self._n):
+            self._send_to(i, msg)
+
+    def _send_to(self, i: int, msg) -> None:
+        with self._lock:
+            slot = self._workers[i]
+            if slot is None or slot["duplex"].closed:
+                # respawn window: park (bounded) — flushed on revival
+                if len(self._pending[i]) < 10_000:
+                    self._pending[i].append(msg)
+                return
+            duplex = slot["duplex"]
+        try:
+            duplex.send(msg)
+        except OSError:
+            with self._lock:
+                if len(self._pending[i]) < 10_000:
+                    self._pending[i].append(msg)
+
+    def _from_worker(self, i: int, msg) -> None:
+        if isinstance(msg, dict) and msg.get("type") == "Reply":
+            qid = msg.get("queryId")
+            if (
+                isinstance(qid, list)
+                and len(qid) == 3
+                and qid[0] == "_tele"
+            ):
+                self._tele_collect(qid[1], qid[2], msg.get("payload"))
+                return
+        self._dispatch(msg)
+
+    # -- telemetry fan-out/merge ---------------------------------------
+
+    def _telemetry_fanout(self, msg) -> None:
+        with self._lock:
+            tok = self._next_tele
+            self._next_tele += 1
+            slot = {
+                "qid": msg.get("queryId"),
+                "left": set(range(self._n)),
+                "payloads": {},
+                "timer": None,
+            }
+            self._tele[tok] = slot
+        timer = threading.Timer(2.0, self._tele_finish, args=(tok,))
+        timer.daemon = True
+        slot["timer"] = timer
+        timer.start()
+        for i in range(self._n):
+            self._send_to(
+                i,
+                {
+                    "type": "Query",
+                    "queryId": ["_tele", tok, i],
+                    "query": {"type": "Telemetry"},
+                },
+            )
+
+    def _tele_collect(self, tok: int, i: int, payload) -> None:
+        with self._lock:
+            slot = self._tele.get(tok)
+            if slot is None:
+                return  # timer already fired with partial results
+            slot["payloads"][i] = payload
+            slot["left"].discard(i)
+            done = not slot["left"]
+        if done:
+            self._tele_finish(tok)
+
+    def _tele_finish(self, tok: int) -> None:
+        with self._lock:
+            slot = self._tele.pop(tok, None)
+        if slot is None:
+            return
+        if slot["timer"] is not None:
+            slot["timer"].cancel()
+        self._dispatch(
+            {
+                "type": "Reply",
+                "queryId": slot["qid"],
+                "payload": self._merge_tele(slot["payloads"]),
+            }
+        )
+
+    def _merge_tele(self, payloads: Dict[int, Any]) -> Dict[str, Any]:
+        """One fleet-shaped payload from N worker payloads: counters
+        sum, `time` is the max, net doc tables union, and a `workers`
+        block (mirrored into `workers.<i>.*` counters so counter-only
+        consumers like the Prometheus dump see them too) carries the
+        per-worker split."""
+        counters: Dict[str, Any] = {}
+        merged: Dict[str, Any] = {
+            "counters": counters,
+            "time": 0.0,
+            "workers": {},
+        }
+        for i in range(self._n):
+            p = payloads.get(i)
+            with self._lock:
+                slot = self._workers[i]
+                queue = (
+                    len(slot["duplex"]._outbox)
+                    if slot is not None
+                    else 0
+                )
+                respawns = self._respawns[i]
+                pid = slot["pid"] if slot is not None else None
+                alive = p is not None
+            edits = 0
+            if isinstance(p, dict):
+                for name, v in (p.get("counters") or {}).items():
+                    if isinstance(v, (int, float)):
+                        counters[name] = counters.get(name, 0) + v
+                if isinstance(p.get("time"), (int, float)):
+                    merged["time"] = max(merged["time"], p["time"])
+                for section in ("serve", "dht"):
+                    if section in p and section not in merged:
+                        merged[section] = p[section]
+                net = p.get("net")
+                if isinstance(net, dict):
+                    merged.setdefault("net", {"docs": {}})[
+                        "docs"
+                    ].update(net.get("docs") or {})
+                pc = p.get("counters") or {}
+                # WAL appends count every locally-written change block
+                # on the durable plane (the hot-doc bench's metric);
+                # engine-applied changes cover the WAL-off config
+                edits = pc.get("storage.wal.appends") or pc.get(
+                    "live.local_changes", 0
+                )
+            merged["workers"][str(i)] = {
+                "pid": pid,
+                "alive": alive,
+                "edits": edits,
+                "queue": queue,
+                "respawns": respawns,
+            }
+            counters[f"workers.{i}.edits"] = edits
+            counters[f"workers.{i}.queue"] = queue
+            counters[f"workers.{i}.respawns"] = respawns
+        return merged
 
 
 def serve_backend(
@@ -285,13 +701,25 @@ def serve_backend(
                 swarm.connect((h, int(p)))
         return back
 
-    back = build_backend()
     if hub:
         # many-frontend mode: every accepted connection joins the hub;
         # the backend's push stream routes by doc interest and Replies
-        # by issuing connection. The daemon runs until killed.
-        hub_obj = _FrontendHub(back)
-        back.subscribe(hub_obj.dispatch)
+        # by issuing connection. The daemon runs until killed. With
+        # HM_WORKERS=N (> 0) the "backend" is a _ShardRouter over N
+        # per-doc-range worker processes instead of an in-process
+        # RepoBackend — the hub neither loads XLA nor holds the GIL
+        # for engine work, and disjoint shards commit in parallel
+        # across real processes. (Worker daemons own their own repos;
+        # swarm flags apply to single-backend daemons only.)
+        workers = int(os.environ.get("HM_WORKERS", "0") or "0")
+        if workers > 0:
+            back = _ShardRouter(repo_path, sock_path, workers)
+            hub_obj = _FrontendHub(back)
+            back.start(hub_obj.dispatch, hub_obj.snapshot_interest)
+        else:
+            back = build_backend()
+            hub_obj = _FrontendHub(back)
+            back.subscribe(hub_obj.dispatch)
         try:
             while True:
                 conn, _ = server.accept()
@@ -305,6 +733,7 @@ def serve_backend(
             if os.path.exists(sock_path):
                 os.remove(sock_path)
         return
+    back = build_backend()
     idle_sink = False  # a discard sink is attached between frontends
     fence = ReplyFence()  # queryIds are epoch-tagged per frontend: a
     # previous frontend's in-flight handler cannot deliver its late

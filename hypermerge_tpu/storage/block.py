@@ -5,6 +5,8 @@ with a 2-byte magic header and falls back to raw JSON when compression
 doesn't help, sniffing `{` for legacy blocks (reference src/Block.ts:6-29).
 
 Dispatch is by header:
+  '\\xc5\\x01' binary change frame            (crdt/codec.py, preferred
+                                            for change blocks)
   'BR' + uint32le raw_len + brotli stream   (native layer, preferred)
   'ZL' + zlib stream                        (pure-Python fallback)
   '{' / '['                                 raw JSON (incompressible)
@@ -13,7 +15,10 @@ Writers pick brotli when the native layer loaded (HM_BLOCK_CODEC=zlib
 forces the fallback); readers handle every format, so feeds written by
 either configuration stay readable — except brotli-written feeds on a
 machine that cannot load the native layer, which fail loudly rather
-than silently misparse.
+than silently misparse. Change blocks go through `pack_change`, which
+prefers the binary change frame (GIL-free native encode/decode; the
+HM_NATIVE_CODEC=0 hatch reverts new writes to the JSON formats while
+readers keep handling frames already on disk).
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ import zlib
 from typing import Any
 
 from .. import native
+from ..crdt import codec as change_codec
 from ..utils.json_buffer import bufferify, parse
 
 _ZLIB_MAGIC = b"ZL"
@@ -39,6 +45,21 @@ def _use_brotli() -> bool:
 
 
 def pack(obj: Any) -> bytes:
+    return pack_raw(bufferify(obj))
+
+
+def pack_change(obj: Any) -> bytes:
+    """Pack a change dict, preferring the binary change frame for the
+    small interactive blocks the per-edit hot loop emits — the encode
+    runs in C with the GIL released and the frame undercuts raw JSON.
+    Big blocks (bulk text pastes) keep the brotli path: there the
+    payload dominates and compression beats a flat frame on disk.
+    Off-canon shapes and the HM_NATIVE_CODEC=0 hatch fall back to the
+    JSON block path."""
+    if change_codec.enabled():
+        frame = change_codec.encode_change(obj)
+        if frame is not None and len(frame) < _MIN_COMPRESS:
+            return frame
     return pack_raw(bufferify(obj))
 
 
@@ -74,6 +95,12 @@ _MAX_RATIO = 2048
 
 def unpack(data: bytes) -> Any:
     magic = data[:2]
+    if magic == change_codec.MAGIC:
+        # binary change frame: decode (native when available) back to
+        # canonical JSON bytes, then parse like any raw block. Readers
+        # take this branch regardless of HM_NATIVE_CODEC — the hatch
+        # only stops new frames being written.
+        return parse(change_codec.decode_change(data))
     if magic == _BROTLI_MAGIC:
         if len(data) < 2 + _BR_LEN.size:
             raise ValueError("corrupt brotli block: truncated header")

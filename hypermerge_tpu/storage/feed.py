@@ -349,6 +349,14 @@ class FileFeedStorage:
 
     def get(self, index: int) -> bytes:
         self._ensure_scan()
+        if index >= len(self._offsets):
+            # the .len sidecar can promise more blocks than the scan
+            # could parse (tampered/torn size header): the log truly
+            # ends here — IndexError, not a silent empty read
+            raise IndexError(
+                f"block {index} beyond scanned log end "
+                f"({len(self._offsets)} block(s))"
+            )
         with open(self.path, "rb") as fh:
             fh.seek(self._offsets[index])
             return fh.read(self._sizes[index])
@@ -621,7 +629,17 @@ class Feed:
     def get_batch(self, start: int, end: int) -> List[bytes]:
         with self._lock:
             end = min(end, len(self._storage))
-            return [self._storage.get(i) for i in range(start, end)]
+            out = []
+            for i in range(start, end):
+                try:
+                    out.append(self._storage.get(i))
+                except IndexError:
+                    # count index ran ahead of what the block log can
+                    # actually parse (tampered/torn header): hand the
+                    # caller the true short log — the integrity audit
+                    # turns the shortfall into AUDIT_TAMPERED
+                    break
+            return out
 
     def read_all(self) -> List[bytes]:
         return self.get_batch(0, self.length)

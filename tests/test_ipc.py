@@ -21,7 +21,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ENV = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT}
 
 
-def _start_backend(repo_arg: str, *extra):
+def _start_backend(repo_arg: str, *extra, env_extra=None):
     """Spawn a backend daemon; returns (proc, sock_path, swarm_addr)."""
     sock = tempfile.mktemp(suffix=".sock")
     proc = subprocess.Popen(
@@ -30,7 +30,7 @@ def _start_backend(repo_arg: str, *extra):
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
-        env=ENV,
+        env={**ENV, **(env_extra or {})},
         cwd=REPO_ROOT,
     )
     deadline = time.time() + 60
@@ -559,12 +559,11 @@ def test_hub_reply_routing_per_connection(tmp_path):
 
 def test_hub_shared_doc_watcher_sees_writer_patches(tmp_path):
     """A hub frontend WATCHING a doc another connection writes receives
-    every patch (interest routing is per doc, not per creator). Note
-    the supported topology: one WRITING frontend per doc — the backend
-    grants one writable actor per doc, so a second connection editing
-    the same doc would collide on its seq counter (concurrent shared-
-    doc writers go through separate daemons + replication, as in the
-    reference design); hub mode's concurrency win is DISJOINT docs."""
+    every patch (interest routing is per doc, not per creator). The
+    watcher here never writes, so it stays in read mode on the actor
+    the backend granted it (None); test_hub_many_writers_one_hot_doc
+    covers the MANY-writer case where every connection mints its own
+    actor."""
     proc, sock, _ = _start_backend(str(tmp_path / "repo"), "--hub")
     try:
         from hypermerge_tpu.net.ipc import connect_frontend
@@ -624,3 +623,113 @@ def test_hub_interest_table_drops_empty_entries():
     d2.close_cb()  # last watcher detaches: table empties
     assert hub._interest == {}
     assert hub._conns == {}
+
+
+def test_hub_many_writers_one_hot_doc(tmp_path):
+    """MANY writers, ONE hot doc: 4 connections all edit the same doc
+    through one hub daemon. The hub tags each connection's Create/Open/
+    NeedsActorId with its connection key, the backend mints one actor
+    PER CONNECTION (so concurrent writers never collide on a shared
+    seq counter), and after the herd drains every connection's view is
+    bit-identical canonical JSON."""
+    import json as _json
+
+    proc, sock, _ = _start_backend(str(tmp_path / "repo"), "--hub")
+    try:
+        from hypermerge_tpu.net.ipc import connect_frontend
+
+        n_writers, n_edits = 4, 8
+        fronts = [connect_frontend(sock) for _ in range(n_writers)]
+        url = fronts[0][0].create({"edits": {}})
+        handles = []
+        for front, _close in fronts:
+            h = front.open(url)
+            # a blank pre-init snapshot is legal (the init change may
+            # still be in flight); wait for the init patch to land
+            _wait(lambda h=h: "edits" in (_val(h) or {}))
+            handles.append(h)
+
+        def churn(w):
+            front = fronts[w][0]
+            for i in range(n_edits):
+                front.change(
+                    url,
+                    lambda d, w=w, i=i: d["edits"].__setitem__(
+                        f"{w}.{i}", i
+                    ),
+                )
+
+        ts = [
+            threading.Thread(target=churn, args=(w,))
+            for w in range(n_writers)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        total = n_writers * n_edits
+        for h in handles:  # every writer converges on the full herd
+            _wait(
+                lambda h=h: len((_val(h) or {}).get("edits", {}))
+                == total,
+                timeout=90,
+            )
+        digests = {
+            _json.dumps(_val(h), sort_keys=True) for h in handles
+        }
+        assert len(digests) == 1, "writers diverged on the hot doc"
+        for _front, close in fronts:
+            close()
+    finally:
+        _stop(proc, sock)
+
+
+def test_hub_sharded_workers_route_and_merge_telemetry(tmp_path):
+    """HM_WORKERS=2 grows the hub into a router over per-doc-range
+    worker PROCESSES: docs land on the worker that owns their shard,
+    edits round-trip through the worker's own engine, and a Telemetry
+    query fans out to every worker and merges into one fleet payload
+    whose `workers` block carries the live per-worker split."""
+    proc, sock, _ = _start_backend(
+        str(tmp_path / "repo"), "--hub", env_extra={"HM_WORKERS": "2"}
+    )
+    try:
+        assert "ready" in proc.stdout.readline()
+        pids = {}
+        for _ in range(2):  # "worker <i> pid <pid>" per spawned worker
+            parts = proc.stdout.readline().split()
+            assert parts[0] == "worker" and parts[2] == "pid", parts
+            pids[parts[1]] = int(parts[3])
+        assert set(pids) == {"0", "1"}
+
+        from hypermerge_tpu.net.ipc import _shard_of, connect_frontend
+
+        front, close = connect_frontend(sock)
+        urls, shards = [], set()
+        while len(shards) < 2 or len(urls) < 4:  # cover BOTH shards
+            url = front.create({"edits": []})
+            urls.append(url)
+            shards.add(_shard_of(url[len("hypermerge:/"):], 2))
+        handles = [front.open(u) for u in urls]
+        for h in handles:
+            _wait(lambda h=h: "edits" in (_val(h) or {}))
+        for u in urls:
+            front.change(u, lambda d: d["edits"].append(1))
+        for h in handles:  # edits round-trip through the owning worker
+            _wait(lambda h=h: (_val(h) or {}).get("edits") == [1])
+
+        got = []
+        front.telemetry(got.append)
+        _wait(lambda: got, timeout=15)
+        workers = got[0].get("workers")
+        assert set(workers) == {"0", "1"}, workers
+        for i, w in workers.items():
+            assert w["alive"], f"worker {i} missed the telemetry fanout"
+            assert w["pid"] == pids[i]
+            assert w["respawns"] == 0
+        # the per-worker split is mirrored into counters for
+        # counter-only consumers (tools/top.py groups, the prom dump)
+        assert "workers.0.edits" in got[0]["counters"]
+        close()
+    finally:
+        _stop(proc, sock)

@@ -834,3 +834,167 @@ def test_commit_ack_covers_unjournaled_legacy_appends(
             p.startswith("feeds/") for p in counts
         ), f"legacy append not fsynced at ack: {counts}"
         dm.close()
+
+
+# ---------------------------------------------------------------------------
+# the crash matrix crossed with the sharded write plane: kill -9 a
+# worker PROCESS mid-burst and hold the same gate — acked_lost=0
+
+
+def test_worker_sigkill_midburst_acked_lost_zero(tmp_path):
+    """SIGKILL the worker that OWNS a hot doc's shard mid-burst under
+    HM_FSYNC=1 + durable acks: the hub supervises a respawn, the fresh
+    worker replays its journal prefix, and every edit whose durable
+    ack the writer received survives (acked_lost=0). The one write in
+    flight INSIDE the dead worker is allowed to vanish — it was never
+    acked — and a brand-new connection both reads the recovered doc
+    and writes to it (the backend mints it a fresh actor; grants died
+    with the worker and are never resurrected).
+
+    The ack signal is a second OBSERVER connection's watch state: the
+    writer's own handle fans out each change preview optimistically,
+    but the observer's value moves only when the backend's patch
+    broadcast arrives — and under HM_ACK_DURABLE that broadcast is
+    gated on the WAL group commit covering the edit."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import time
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    sock = tempfile.mktemp(suffix=".sock")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo_root,
+        "HM_FSYNC": "1",
+        "HM_ACK_DURABLE": "1",
+        "HM_WAL_MS": "3",
+        "HM_WORKERS": "2",
+        "HM_WORKER_RESPAWN_MS": "100",
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hypermerge_tpu.net.ipc",
+         str(tmp_path / "repo"), sock, "--hub"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=repo_root,
+    )
+    lines = []
+    threading.Thread(
+        target=lambda: lines.extend(iter(proc.stdout.readline, "")),
+        daemon=True,
+    ).start()
+
+    def _sync(fn, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if fn():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def _val(handle):
+        try:
+            return handle.value(timeout=0.2)
+        except TimeoutError:
+            return None
+
+    closers = []
+    try:
+        assert _sync(lambda: os.path.exists(sock)), "daemon not up"
+        assert _sync(
+            lambda: sum("worker" in ln for ln in lines) >= 2
+        ), lines
+        pids = {}
+        for ln in list(lines):
+            parts = ln.split()
+            if parts[:1] == ["worker"] and "respawned" not in parts:
+                pids[int(parts[1])] = int(parts[3])
+
+        from hypermerge_tpu.net.ipc import _shard_of, connect_frontend
+
+        front, close = connect_frontend(sock)
+        closers.append(close)
+        url = front.create({"edits": {}})
+        h = front.open(url)
+        assert _sync(lambda: "edits" in (_val(h) or {}))
+        owner = _shard_of(url[len("hypermerge:/"):], 2)
+
+        # the durable-ack probe: a read-only connection whose value
+        # only the backend's (durability-gated) patch pushes can move
+        obs, close_obs = connect_frontend(sock)
+        closers.append(close_obs)
+        hobs = obs.open(url)
+        assert _sync(lambda: "edits" in (_val(hobs) or {}))
+
+        def _acked(key, val, timeout=10):
+            return _sync(
+                lambda: (_val(hobs) or {})
+                .get("edits", {}).get(key) == val,
+                timeout=timeout,
+            )
+
+        acked = []
+        for i in range(8):  # ack-paced burst: durable echo gates each
+            front.change(
+                url, lambda d, i=i: d["edits"].__setitem__(str(i), i)
+            )
+            assert _acked(str(i), i), f"edit {i} never acked"
+            acked.append(str(i))
+
+        os.kill(pids[owner], signal.SIGKILL)  # mid-burst: kill -9
+        # the next write races worker-death detection: it either lands
+        # after the respawn (hub buffered it) or was swallowed by the
+        # dying socket — it only joins the gate if its ack came back
+        front.change(
+            url, lambda d: d["edits"].__setitem__("post-kill", 1)
+        )
+        if _acked("post-kill", 1, timeout=5):
+            acked.append("post-kill")
+
+        assert _sync(
+            lambda: any("respawned" in ln for ln in lines)
+        ), "hub never respawned the killed worker"
+
+        # a brand-new connection sees every acked edit: the respawned
+        # worker replayed them from the journal prefix (acked_lost=0)
+        f2, close2 = connect_frontend(sock)
+        closers.append(close2)
+        h2 = f2.open(url)
+        assert _sync(lambda: "edits" in (_val(h2) or {}))
+
+        def _lost():
+            edits = (_val(h2) or {}).get("edits", {})
+            return [k for k in acked if k not in edits]
+
+        assert _sync(lambda: not _lost(), timeout=20), (
+            f"acked edits lost across worker kill -9: {_lost()}"
+        )
+        # ...and can WRITE: the backend mints the new connection a
+        # fresh actor rather than resurrecting a dead grant
+        f2.change(
+            url, lambda d: d["edits"].__setitem__("fresh", 1)
+        )
+        assert _sync(
+            lambda: (_val(h2) or {})
+            .get("edits", {}).get("fresh") == 1,
+            timeout=15,
+        ), "respawned worker refuses new writers"
+    finally:
+        for close in closers:
+            try:
+                close()
+            except Exception:
+                pass
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=10)
+        if os.path.exists(sock):
+            os.remove(sock)

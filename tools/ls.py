@@ -25,6 +25,16 @@ daemon (net/discovery/ DhtSwarm) a `dht:` header line adds the node
 id, routing-table size, and stored announce-record count — the same
 block `tools/meta.py --dht` probes from outside.
 
+The `workers=` column (shown when --sock points at a sharded hub
+daemon, `net/ipc.py --hub` + `HM_WORKERS=N`) names the worker process
+that OWNS each doc's shard as `workers=<shard>/<N>` — every Change for
+the doc routes through that worker's engine and WAL — and a `workers:`
+header line summarizes the fleet from the Telemetry payload: how many
+workers are up, their summed durable edits, and supervisor respawns.
+A sharded daemon's docs live in per-worker shard repos
+(`<repo>/shard-<k>`); ls walks those too, one `shard-k  N docs`
+section each.
+
 The `scrub=` column surfaces crash damage without a full scrub
 (storage/scrub.py doc_status): `ok`, `recovered` (the last crash
 recovery repaired something for this doc's feeds — torn tails,
@@ -162,12 +172,31 @@ def main() -> None:
             f"joined={len(dht['joined'])}"
         )
 
+    workers = payload.get("workers") or {}
+    if workers:
+        # sharded hub daemon: one fleet summary line; the per-doc
+        # workers= column below names each doc's owning shard
+        up = sum(1 for w in workers.values() if w.get("alive"))
+        print(
+            f"workers: {up}/{len(workers)} up "
+            f"edits={sum(w.get('edits', 0) for w in workers.values())} "
+            f"respawns="
+            f"{sum(w.get('respawns', 0) for w in workers.values())}"
+        )
+
     def swarm_cols(doc_id):
         ent = net.get(doc_id)
         if ent is None:
             return ""
         ann = "yes" if ent.get("announced") else "no"
         return f"peers={ent.get('peers', 0)} announce={ann} "
+
+    def worker_col(doc_id):
+        if not workers:
+            return ""
+        from hypermerge_tpu.net.ipc import _shard_of
+
+        return f"workers={_shard_of(doc_id, len(workers))}/{len(workers)} "
 
     def residency(doc_id):
         if serve is None:
@@ -179,35 +208,51 @@ def main() -> None:
             return "evicted"
         return "host"
 
-    for doc_id in doc_ids:
-        cursor = back.cursors.get(back.id, doc_id)
-        clock = back.clocks.get(back.id, doc_id)
-        total_changes = sum(clock.values())
-        nbytes = sum(_feed_bytes(args.repo, a) for a in cursor)
-        line = (
-            f"{to_doc_url(doc_id)}  actors={len(cursor)} "
-            f"changes={total_changes} bytes={nbytes} "
-            f"{swarm_cols(doc_id)}"
-            f"residency={residency(doc_id)} "
-            f"scrub={doc_status(back, doc_id, report)} "
-            f"wal={wal_status(report, cursor)}"
-        )
-        if args.audit:
-            # three-way status: OK / UNSIGNED-TAIL (crash-orphaned
-            # lazy-signing tail, recoverable via seal()) / TAMPERED
-            statuses = {
-                back.feeds.open_feed(a).audit_status() for a in cursor
-            }
-            if AUDIT_TAMPERED in statuses:
-                line += "  integrity=TAMPERED"
-            elif AUDIT_UNSIGNED_TAIL in statuses:
-                line += (
-                    "  integrity=UNSIGNED-TAIL (seal() to re-sign)"
-                )
-            else:
-                line += "  integrity=OK"
-        print(line)
+    def list_docs(b, path, rep):
+        for doc_id in b.clocks.all_doc_ids(b.id):
+            cursor = b.cursors.get(b.id, doc_id)
+            clock = b.clocks.get(b.id, doc_id)
+            total_changes = sum(clock.values())
+            nbytes = sum(_feed_bytes(path, a) for a in cursor)
+            line = (
+                f"{to_doc_url(doc_id)}  actors={len(cursor)} "
+                f"changes={total_changes} bytes={nbytes} "
+                f"{swarm_cols(doc_id)}"
+                f"{worker_col(doc_id)}"
+                f"residency={residency(doc_id)} "
+                f"scrub={doc_status(b, doc_id, rep)} "
+                f"wal={wal_status(rep, cursor)}"
+            )
+            if args.audit:
+                # three-way status: OK / UNSIGNED-TAIL (crash-orphaned
+                # lazy-signing tail, recoverable via seal()) / TAMPERED
+                statuses = {
+                    b.feeds.open_feed(a).audit_status() for a in cursor
+                }
+                if AUDIT_TAMPERED in statuses:
+                    line += "  integrity=TAMPERED"
+                elif AUDIT_UNSIGNED_TAIL in statuses:
+                    line += (
+                        "  integrity=UNSIGNED-TAIL (seal() to re-sign)"
+                    )
+                else:
+                    line += "  integrity=OK"
+            print(line)
+
+    list_docs(back, args.repo, report)
     repo.close()
+    # a sharded hub daemon's docs live in per-worker shard repos
+    # (<repo>/shard-<k>, net/ipc.py _ShardRouter) — the top-level dir
+    # holds no feeds of its own, so list each shard's inventory too
+    for name in sorted(os.listdir(args.repo)):
+        spath = os.path.join(args.repo, name)
+        if not (name.startswith("shard-") and os.path.isdir(spath)):
+            continue
+        srepo = Repo(path=spath)
+        sids = srepo.back.clocks.all_doc_ids(srepo.back.id)
+        print(f"{name}  {len(sids)} docs")
+        list_docs(srepo.back, spath, last_report(spath))
+        srepo.close()
 
 
 if __name__ == "__main__":

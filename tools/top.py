@@ -22,6 +22,13 @@ bounded-fanout claim as a live ratio (suppressed counts the peers the
 ``HM_GOSSIP_FANOUT`` cap skipped per broadcast; anti-entropy sweeps
 never appear here because they are deliberately unsampled).
 
+ISSUE 16's sharded write plane (``--hub`` + ``HM_WORKERS=N``) adds the
+``[workers]`` fleet table: one row per worker PROCESS with pid,
+liveness, durable-edit rate (``storage.wal.appends`` per worker),
+outbound queue depth, and supervisor respawn count — the same split
+the merged payload mirrors into ``workers.<i>.*`` counters for the
+Prometheus dump.
+
 Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
 the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
 per-lock-class blocking-debt series whose ``live_engine`` row is the
@@ -115,6 +122,7 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
     per-second deltas against the previous poll (blank on the first)."""
     counters = cur.get("counters", {})
     prev_counters = (prev or {}).get("counters", {})
+    workers = cur.get("workers") or {}
     by_sub = {}
     for name, v in counters.items():
         sub = name.split(".", 1)[0]
@@ -123,6 +131,8 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
             # glance shows appends vs fsyncs (the O(1)-per-window
             # claim as a live ratio) plus checkpoint/byte flow
             sub = "wal"
+        if workers and name.startswith("workers."):
+            continue  # rendered as the [workers] fleet table below
         by_sub.setdefault(sub, []).append((name, v))
     lines = []
     for sub in sorted(by_sub):
@@ -143,6 +153,26 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
             if isinstance(v, float):
                 v = round(v, 3)
             lines.append(f"  {name:<32} {v:>14,}{rate}")
+    if workers:
+        # the sharded write plane (HM_WORKERS daemons): one row per
+        # worker process — liveness, durable-edit rate, outbound queue
+        # depth, and how often the supervisor had to respawn it
+        lines.append("[workers]")
+        for i in sorted(workers, key=int):
+            w = workers[i]
+            delta = w.get("edits", 0) - prev_counters.get(
+                f"workers.{i}.edits", 0
+            )
+            rate = ""
+            if prev and dt > 0 and delta:
+                rate = f"  ({delta / dt:+,.1f}/s)"
+            state = "up" if w.get("alive") else "DOWN"
+            lines.append(
+                f"  worker {i}  pid {w.get('pid')}  {state:<4} "
+                f"edits {w.get('edits', 0):>10,}{rate}  "
+                f"queue {w.get('queue', 0):,}  "
+                f"respawns {w.get('respawns', 0):,}"
+            )
     if cur.get("tracing"):
         lines.append(
             f"[trace] {cur.get('trace_spans', 0)} spans buffered"
