@@ -949,6 +949,226 @@ def _config_swarm(n_peers=None, n_edits=24):
                 os.environ[k] = v
 
 
+def _config_fleet1000():
+    """THIS round's scaling config: does the per-peer steady-state
+    bill stay flat from 100 to 1000 peers? Two parts:
+
+    1. A REAL mini-fleet on the async transport (HM_NET_ASYNC=1,
+       HM_CURSOR_DELTA=1): measures threads per daemon (the selector
+       loop must not spend a thread per connection), real cold-join
+       walls, and the live delta/suppressed cursor split.
+    2. A deterministic SEEDED simulation of the steady-state gossip
+       period at N=100 and N=1000 using the production GossipSampler
+       + the delta-cursor ledger rule (send only entries the target
+       has not acked; all-caught-up suppresses the frame; max-wins
+       merge). frames/peer/period must stay flat within 2x across the
+       10x fleet — O(fanout), not O(peers). Cold-join p99 at N=1000 is
+       extrapolated from the real samples by the Kademlia hop ratio
+       log(1000)/log(n_real) (labelled simulated in BASELINE.md)."""
+    import math
+    import random as _rnd
+    import threading as _th
+    import time as _t
+
+    from hypermerge_tpu import telemetry as _tele
+    from hypermerge_tpu.net.aio import get_loop
+    from hypermerge_tpu.net.discovery import (
+        DhtNode, DhtSwarm, GossipSampler,
+    )
+    from hypermerge_tpu.repo import Repo
+
+    t_start = _t.perf_counter()
+    fanout = 4
+    n_real = int(os.environ.get("BENCH_FLEET_REAL_PEERS", "12"))
+    env_save = {
+        k: os.environ.get(k)
+        for k in (
+            "HM_NET_ASYNC", "HM_CURSOR_DELTA", "HM_REDIAL_BASE_MS",
+            "HM_REDIAL_MAX_S", "HM_DHT_ANNOUNCE_S", "HM_DHT_LOOKUP_S",
+            "HM_GOSSIP_FANOUT", "HM_GOSSIP_RESHUFFLE_S", "HM_NET_PING_S",
+        )
+    }
+    boot = None
+    repos, swarms = [], []
+    try:
+        os.environ["HM_NET_ASYNC"] = "1"
+        os.environ["HM_CURSOR_DELTA"] = "1"
+        os.environ["HM_REDIAL_BASE_MS"] = "50"
+        os.environ["HM_REDIAL_MAX_S"] = "1"
+        os.environ["HM_DHT_ANNOUNCE_S"] = "0.5"
+        os.environ["HM_DHT_LOOKUP_S"] = "0.5"
+        os.environ["HM_GOSSIP_FANOUT"] = str(fanout)
+        os.environ["HM_GOSSIP_RESHUFFLE_S"] = "0.5"
+        os.environ["HM_NET_PING_S"] = "0"
+        # the loop singleton and its dispatch pool are process-wide
+        # infra: create them BEFORE the census so the count charges
+        # per-daemon cost only
+        get_loop()
+        boot = DhtNode()
+        snap0 = _tele.snapshot()
+        threads0 = _th.active_count()
+        for _i in range(n_real):
+            r = Repo(memory=True)
+            sw = DhtSwarm(bootstrap=[boot.address])
+            r.set_swarm(sw)
+            repos.append(r)
+            swarms.append(sw)
+        url = repos[0].create({"edits": []})
+        t_open = _t.perf_counter()
+        handles = [r.open(url) for r in repos[1:]]
+        join_s = [None] * len(handles)
+        deadline = _t.perf_counter() + 120
+        while any(j is None for j in join_s):
+            assert _t.perf_counter() < deadline, "cold joins stalled"
+            for i, h in enumerate(handles):
+                if join_s[i] is not None:
+                    continue
+                try:
+                    if h.value(timeout=0.01) is not None:
+                        join_s[i] = _t.perf_counter() - t_open
+                except TimeoutError:
+                    pass
+            _t.sleep(0.02)
+        # a short steady-state burst so the cursor split has signal
+        for i in range(24):
+            repos[0].change(url, lambda d, i=i: d["edits"].append(i))
+        want = list(range(24))
+        deadline = _t.perf_counter() + 60
+        while _t.perf_counter() < deadline:
+            if all(
+                (h.value() or {}).get("edits") == want for h in handles
+            ):
+                break
+            _t.sleep(0.02)
+        else:
+            raise AssertionError("config_fleet1000 burst did not converge")
+        threads_per_daemon = (_th.active_count() - threads0) / n_real
+        snap1 = _tele.snapshot()
+
+        def _grew(name):
+            return snap1.get(name, 0) - snap0.get(name, 0)
+
+        aio_conns = snap1.get("net.aio.conns", 0)
+        delta_tx = _grew("net.cursor.delta_tx")
+        suppressed = _grew("net.cursor.suppressed")
+        full_tx = _grew("net.cursor.full_tx")
+    finally:
+        for r in repos:
+            try:
+                r.close()
+            except Exception:
+                pass
+        for sw in swarms:
+            try:
+                sw.destroy()
+            except Exception:
+                pass
+        if boot is not None:
+            boot.close()
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # -- part 2: seeded steady-state period model, N=100 vs N=1000 ----
+    class _P:
+        __slots__ = ("id",)
+
+        def __init__(self, i):
+            self.id = f"p{i:04d}"
+
+    def frames_per_peer_period(n, periods=24):
+        peers = [_P(i) for i in range(n)]
+        others = [peers[:i] + peers[i + 1:] for i in range(n)]
+        # reshuffle every round: the production sampler reshuffles its
+        # subset every HM_GOSSIP_RESHUFFLE_S — a frozen subset strands
+        # any peer outside the writer's reach (exactly what the real
+        # anti-entropy sweep + reshuffle exist to repair)
+        samplers = [
+            GossipSampler(fanout=fanout, reshuffle_s=0.0, seed=1000 + i)
+            for i in range(n)
+        ]
+        clocks = [{} for _ in range(n)]  # actor -> seq (max-wins)
+        ledgers = [{} for _ in range(n)]  # target -> {actor: seq} sent
+        frames = 0
+        counted_from = periods // 2  # let the relay pipeline fill
+
+        def _round(p, count):
+            nonlocal frames
+            sends = []
+            for i in range(n):
+                for tgt in samplers[i].sample("doc", others[i]):
+                    j = int(tgt.id[1:])
+                    sent = ledgers[i].setdefault(j, {})
+                    delta = {
+                        a: s for a, s in clocks[i].items()
+                        if sent.get(a, -1) < s
+                    }
+                    if not delta:
+                        continue  # all caught up: frame suppressed
+                    sent.update(delta)
+                    sends.append((j, delta))
+                    if count:
+                        frames += 1
+            for j, delta in sends:  # synchronous round: apply after
+                for a, s in delta.items():
+                    if clocks[j].get(a, -1) < s:
+                        clocks[j][a] = s
+
+        for p in range(periods):
+            clocks[0]["w"] = p + 1  # one edit per period at the writer
+            _round(p, p >= counted_from)
+        # drain: no new edits — the fleet must converge BIT-identically
+        # (every peer holds the writer's exact clock) within the relay
+        # diameter, or the delta ledger dropped an entry somewhere
+        for _ in range(30):
+            if all(c == clocks[0] for c in clocks):
+                break
+            _round(periods, False)
+        else:
+            raise AssertionError(
+                f"simulated {n}-peer fleet never converged"
+            )
+        fpp = frames / (n * (periods - counted_from))
+        # one edit per period, so frames/peer/period IS the per-edit
+        # frame amplification: the soak's O(fanout) gate must hold at
+        # simulated 1000-peer scale too
+        assert fpp <= 4 * fanout + 8, fpp
+        return fpp
+
+    f100 = frames_per_peer_period(100)
+    f1000 = frames_per_peer_period(1000)
+
+    # -- cold-join p99 at N=1000: real samples scaled by hop ratio ----
+    rnd = _rnd.Random(1000)
+    hop_scale = math.log(1000) / math.log(max(n_real, 2))
+    sims = sorted(
+        rnd.choice(join_s) * hop_scale * rnd.uniform(0.8, 1.25)
+        for _ in range(1000)
+    )
+    coldjoin_p99 = sims[int(len(sims) * 0.99)]
+
+    out = {
+        "real_peers": n_real,
+        "threads_per_daemon": round(threads_per_daemon, 2),
+        "aio_conns": aio_conns,
+        "cursor_full_tx": full_tx,
+        "cursor_delta_tx": delta_tx,
+        "cursor_suppressed": suppressed,
+        "frames_per_peer_period_100": round(f100, 3),
+        "frames_per_peer_period_1000": round(f1000, 3),
+        "frames_flat_ratio": round(f1000 / max(f100, 1e-9), 2),
+        "coldjoin_p99_s": round(coldjoin_p99, 2),
+    }
+    # the scaling claims: 10x the fleet must not move the per-peer
+    # steady-state bill (within 2x), and steady state must run on
+    # delta/suppressed frames, not full cursor maps
+    assert out["frames_flat_ratio"] <= 2.0, out
+    assert delta_tx + suppressed > 0, out
+    return round(_t.perf_counter() - t_start, 2), out
+
+
 _CRASH_CHILD = r"""
 import os, sys
 sys.path.insert(0, sys.argv[2])
@@ -1743,6 +1963,19 @@ def main() -> None:
             f"{cfgsw[1]['lookup_hops_mean']}; {cfgsw[1]})",
             file=sys.stderr,
         )
+    cfgfl = _soft("config_fleet1000", _config_fleet1000)
+    if cfgfl is not None:
+        print(
+            f"# config_fleet1000 scaling: {cfgfl[1]['real_peers']}-peer "
+            f"async fleet at {cfgfl[1]['threads_per_daemon']} "
+            f"threads/daemon; frames/peer/period "
+            f"{cfgfl[1]['frames_per_peer_period_100']} @100 vs "
+            f"{cfgfl[1]['frames_per_peer_period_1000']} @1000 "
+            f"(ratio {cfgfl[1]['frames_flat_ratio']}x, gate <= 2x); "
+            f"cold-join p99 {cfgfl[1]['coldjoin_p99_s']}s simulated "
+            f"({cfgfl[1]})",
+            file=sys.stderr,
+        )
     cfgcr = _soft("config_crash", _config_crash)
     if cfgcr is not None:
         print(
@@ -1903,6 +2136,15 @@ def main() -> None:
                     ),
                     "config_swarm": (
                         cfgsw[1] if cfgsw is not None else None
+                    ),
+                    # 100->1000 peer scaling: async-transport thread
+                    # census (real mini-fleet) + seeded steady-state
+                    # period model; frames/peer/period must stay flat
+                    "config_fleet1000_s": (
+                        cfgfl[0] if cfgfl is not None else None
+                    ),
+                    "config_fleet1000": (
+                        cfgfl[1] if cfgfl is not None else None
                     ),
                     "config_crash_t_recover_ms": (
                         round(cfgcr[0], 1) if cfgcr is not None else None

@@ -214,6 +214,26 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "exchange never completes."),
     EnvVar("HM_FAULT", None, "Deterministic network fault spec "
            "(seed:events...) auto-applied to every swarm."),
+    EnvVar("HM_NET_ASYNC", "0", "=1 multiplexes every TCP connection "
+           "onto the process's selector event loop (net/aio.py): "
+           "non-blocking sockets, loop-driven handshakes and dials, "
+           "keepalives on one timer wheel — O(1) threads per daemon "
+           "instead of ~4 per peer. =0 keeps the wire-compatible "
+           "thread-per-connection twin."),
+    EnvVar("HM_AIO_DISPATCH", "8", "Bounded worker pool that runs "
+           "user-facing callbacks off the event loop thread "
+           "(HM_NET_ASYNC=1)."),
+    EnvVar("HM_TCP_ACCEPT_POOL", "8", "Bounded inbound-handshake "
+           "workers of the thread-per-connection stack; an accept "
+           "storm queues instead of spawning unbounded threads."),
+    EnvVar("HM_CURSOR_DELTA", "1", "Delta cursor gossip: steady-state "
+           "frames carry only actors whose clock advanced since the "
+           "last frame on that connection (full frame on "
+           "(re)connect; repair paths always full). =0 sends full "
+           "maps every frame."),
+    EnvVar("HM_DHT_PUSH_SEED", "0", "=1 push-seeds announced docs to "
+           "the DHT's k-closest nodes at announce time (they open "
+           "the doc and serve the cold-join first wave)."),
     EnvVar("HM_FILE_FETCH_TIMEOUT_S", "15", "Hyperfile range-fetch "
            "timeout."),
     # -- telemetry / analysis ------------------------------------------

@@ -205,12 +205,75 @@ GUARDS: Tuple[GuardedClass, ...] = (
         "SessionSupervisor", "hypermerge_tpu.net.resilience", "net.sup",
         guarded=("_sessions",),
         atomic_read_ok=("_stopped",),
-        init_only=("_dial", "_deliver", "_banned", "_m"),
+        init_only=("_dial", "_deliver", "_banned", "_m", "_connector"),
         unguarded=("_on_status",),
         doc="The outbound session table mutates under net.sup; "
             "`_stopped` is polled lock-free by every session thread's "
-            "redial loop. `_on_status` is a set-once hook registered "
-            "before sessions start.",
+            "redial loop (and checked by the async-mode callback "
+            "chain). `_on_status` is a set-once hook registered "
+            "before sessions start; `_connector` is construction-time "
+            "wiring selecting the async (event-loop) dial mode.",
+    ),
+    GuardedClass(
+        "TcpSwarm", "hypermerge_tpu.net.tcp", "net.tcp.accept",
+        guarded=("_accept_q", "_accept_idle", "_accept_workers"),
+        init_only=("_async", "_loop"),
+        doc="The bounded inbound-handshake pool of the legacy "
+            "(thread-per-connection) stack: the accepted-socket queue "
+            "and the idle/spawned worker counters mutate under "
+            "net.tcp.accept (listener thread enqueues, pool workers "
+            "dequeue, destroy() drains). `_async`/`_loop` are the "
+            "construction-time transport-twin selection "
+            "(HM_NET_ASYNC).",
+    ),
+    GuardedClass(
+        "AioLoop", "hypermerge_tpu.net.aio", "net.aio",
+        guarded=("_ready", "_timers"),
+        init_only=("_sel", "_timer_seq", "_wake_r", "_wake_w",
+                   "_worker_cap", "_thread"),
+        doc="The shared event loop's submission state: the ready-"
+            "callback deque and the timer heap mutate under net.aio "
+            "(any thread submits, the loop thread drains). The "
+            "selector itself is mutated ONLY on the loop thread "
+            "(callers go through call_soon), so it needs no lock; "
+            "the self-pipe write is a lock-free wakeup.",
+    ),
+    GuardedClass(
+        "AioLoop(dispatch)", "hypermerge_tpu.net.aio",
+        "net.aio.dispatch",
+        guarded=("_dispatch_q", "_dispatch_idle", "_workers"),
+        doc="The bounded dispatch pool (user-facing callbacks run "
+            "here, never on the loop thread): the work queue and the "
+            "idle/spawned counters mutate under net.aio.dispatch "
+            "(offload() demand-spawns up to HM_AIO_DISPATCH workers).",
+    ),
+    GuardedClass(
+        "AioDuplex", "hypermerge_tpu.net.aio", "net.aio.conn",
+        guarded=("_outbox", "_out_inflight", "_tx_scheduled",
+                 "_rx_pending", "_rx_scheduled", "_close_cbs",
+                 "_ready_fired"),
+        atomic_read_ok=("_out_bytes", "closed"),
+        init_only=("_loop", "_sock", "_identity", "_on_ready",
+                   "_out_cap", "_stall_s", "_drained", "_inbox",
+                   "_session"),
+        unguarded=("_shed", "_rx_eof", "_last_rx", "_last_progress",
+                   "_rbuf", "_wbuf", "_registered", "_events",
+                   "_counted", "_hs_timer", "_ka_timer", "_ka_misses",
+                   "_ka_probe", "_hs_phase", "_hs_offer"),
+        doc="One multiplexed connection: the plaintext outbox, the tx "
+            "kick latch, the ordered inbound-dispatch deque and its "
+            "exactly-one-drainer latch, the close-listener list, and "
+            "the ready-once latch mutate under net.aio.conn (sender "
+            "threads vs the loop thread vs dispatch workers). "
+            "`_out_bytes`/`closed` are written under the lock and "
+            "snapshot-read on the lock-free fast paths (shed check, "
+            "early-outs). The unguarded block is LOOP-CONFINED state "
+            "— read/write buffers, selector registration, the "
+            "handshake machine, keepalive bookkeeping — touched only "
+            "by loop callbacks after construction, plus the monotonic "
+            "`_shed`/`_rx_eof` latches and the stall/liveness "
+            "clocks, whose racing writers all move them the same "
+            "direction.",
     ),
     GuardedClass(
         "NetworkPeer", "hypermerge_tpu.net.peer", "net.peer",
@@ -246,13 +309,20 @@ GUARDS: Tuple[GuardedClass, ...] = (
         guarded=("_pending",),
         init_only=("table", "records", "_rpc_ids", "bootstrap",
                    "public_key", "id"),
-        unguarded=("_closed", "_announce_seed", "_seed"),
+        unguarded=("_closed", "_announce_seed", "_seed",
+                   "_sign_cache", "_seed_hook", "_seeded"),
         doc="The pending-RPC correlation table mutates under "
             "net.dht.rpc (reader thread resolves, timers expire, "
             "senders register). `_closed` is a monotonic shutdown "
             "latch polled by the reader; `_announce_seed` is set-once "
             "wiring installed by set_identity before any join "
-            "traffic; `_seed` is the construction-time node key.",
+            "traffic; `_seed` is the construction-time node key. "
+            "`_sign_cache` is driven only by the swarm maintenance "
+            "thread (announce is its single caller; the boot-time "
+            "set_announce_seed reset precedes any join traffic); "
+            "`_seed_hook` is set-once wiring installed before "
+            "traffic; `_seeded` dedup membership mutates only on the "
+            "UDP reader thread.",
     ),
     GuardedClass(
         "DhtSwarm", "hypermerge_tpu.net.discovery.swarm",

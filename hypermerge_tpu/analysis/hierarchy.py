@@ -317,6 +317,31 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "TcpSwarm._dlock — live duplex tracking.",
     ),
     LockClass(
+        "net.tcp.accept", None,
+        "TcpSwarm._accept_cv — the bounded inbound-handshake pool's "
+        "queue handoff (accept thread vs pool workers). Held for "
+        "deque bookkeeping only; handshakes run outside it.",
+    ),
+    LockClass(
+        "net.aio", None,
+        "aio.AioLoop._lock — the event loop's ready queue + timer "
+        "heap (submitters from any thread vs the loop thread). Held "
+        "for queue/heap bookkeeping only; callbacks and selector "
+        "polling run outside it.",
+    ),
+    LockClass(
+        "net.aio.conn", None,
+        "aio.AioDuplex._lock — one async connection's outbox, close "
+        "listeners and inbound-dispatch latch (senders from any "
+        "thread vs the loop thread vs dispatch workers).",
+    ),
+    LockClass(
+        "net.aio.dispatch", None,
+        "aio.AioLoop._dispatch_cv — the bounded dispatch pool's "
+        "queue handoff. User-facing callbacks run OUTSIDE it on the "
+        "pool workers, never on the loop thread.",
+    ),
+    LockClass(
         "net.dht", None,
         "discovery.dht RoutingTable._lock — the k-bucket array + "
         "replacement caches. Pure table bookkeeping; liveness probes "

@@ -71,8 +71,21 @@ class Actor:
 
     @property
     def changes(self) -> List[Any]:
+        """Slot list sized to the feed's block log, re-checked on EVERY
+        read, not just first touch: append_verified fires its listener
+        callbacks outside the feed lock, so two concurrent backfill
+        batches (multi-source repair after churn) can deliver
+        _on_append out of order or drop a callback mid-fan-out. A slot
+        list that only grew one-per-callback would stay short forever,
+        and every reader that trusts len(changes) — seq_head,
+        changes_in_window, the sidecar sync — would clamp to the stale
+        head and never serve the tail blocks the feed already holds.
+        The block log is authoritative; slots decode lazily from it."""
+        n = self.feed.length
         if self._changes is None:
-            self._changes = [_UNSET] * self.feed.length
+            self._changes = [_UNSET] * n
+        elif len(self._changes) < n:
+            self._changes.extend([_UNSET] * (n - len(self._changes)))
         return self._changes
 
     @property
@@ -129,21 +142,16 @@ class Actor:
     def _on_append(self, index: int, data: bytes) -> None:
         t0 = time.perf_counter()
         with self._lock:
-            if self._changes is None:
-                # first touch happens via an append: size to the
-                # pre-append state (feed.length already counts `index`)
-                self._changes = [_UNSET] * index
-            if index < len(self.changes):
-                if self.changes[index] is not _UNSET:
-                    return  # our own write_change already recorded it
-                # A concurrent first touch of `changes` raced this
-                # callback and pre-sized the list past `index` (it reads
-                # feed.length, which already counts this block). The
-                # slot is _UNSET, so this is still a fresh remote block:
-                # fall through and sync/notify as usual.
-            else:
-                self.changes.append(_UNSET)
-            self.changes[index] = self._parse_block(data, index)
+            # the property sizes to the feed head, which already counts
+            # this block; a callback racing ahead of a batch that
+            # appended earlier indices (listeners fire outside the feed
+            # lock) still lands in bounds
+            cs = self.changes
+            if len(cs) <= index:
+                cs.extend([_UNSET] * (index + 1 - len(cs)))
+            if cs[index] is not _UNSET:
+                return  # our own write_change already recorded it
+            cs[index] = self._parse_block(data, index)
             if self._defer_cache is None:
                 self._sync_cache_locked()
             self._pending_dl[0] += len(data)

@@ -68,6 +68,9 @@ _M_ANNOUNCES = telemetry.counter("dht.announces")
 _M_STORED = telemetry.counter("dht.records_stored")
 _M_REJECTED = telemetry.counter("dht.records_rejected")
 _M_EVICTIONS = telemetry.counter("dht.stale_evictions")
+_M_SIGN_CACHE = telemetry.counter("dht.sign_cache_hits")
+_M_SEEDS_TX = telemetry.counter("dht.seeds_tx")
+_M_SEEDS_RX = telemetry.counter("dht.seeds_rx")
 
 
 def _k() -> int:
@@ -260,6 +263,56 @@ def make_record(
     return rec
 
 
+def _seed_record_bytes(rec: Dict[str, Any]) -> bytes:
+    body = {
+        k: rec[k] for k in ("key", "doc", "ts", "ttl", "pk")
+    }
+    return json.dumps(body, sort_keys=True, separators=(",", ":")).encode(
+        "utf-8"
+    )
+
+
+def make_seed_record(
+    key_hex: str,
+    doc_id: str,
+    seed: bytes,
+    ttl: Optional[float] = None,
+) -> Dict[str, Any]:
+    """A signed push-seed record (HM_DHT_PUSH_SEED): the announcer
+    asks the k nodes closest to `key_hex` — the doc's own keyspace
+    position — to OPEN `doc_id` and become replicas, so the creator
+    stops serving the entire cold-join first wave alone."""
+    pk = crypto.public_key(seed)
+    rec = {
+        "key": key_hex,
+        "doc": str(doc_id),
+        "ts": round(time.time(), 3),
+        "ttl": float(_ttl_s() if ttl is None else ttl),
+        "pk": base64.b64encode(pk).decode("ascii"),
+    }
+    rec["sig"] = base64.b64encode(
+        crypto.sign(_seed_record_bytes(rec), seed)
+    ).decode("ascii")
+    return rec
+
+
+def verify_seed_record(rec: Any, now: Optional[float] = None) -> bool:
+    if not isinstance(rec, dict):
+        return False
+    try:
+        pk = base64.b64decode(rec["pk"])
+        sig = base64.b64decode(rec["sig"])
+        ts = float(rec["ts"])
+        ttl = float(rec["ttl"])
+        payload = _seed_record_bytes(rec)
+    except (KeyError, TypeError, ValueError):
+        return False
+    if not crypto.verify(payload, sig, pk):
+        return False
+    now = time.time() if now is None else now
+    return ts + ttl > now and ts < now + 60
+
+
 def verify_record(rec: Any, now: Optional[float] = None) -> bool:
     """Signature valid AND not expired AND not implausibly future-
     stamped (>60s of clock skew is a forged/replayed ts, not skew)."""
@@ -354,6 +407,13 @@ class DhtNode:
         # swarm wires one (set_announce_seed); the ephemeral node key
         # covers anonymous nodes. Set before traffic flows.
         self._announce_seed = self._seed
+        # announce-record signing cache: re-publishing an unchanged
+        # {key,host,port,ttl} within the TTL window reuses the signed
+        # record instead of paying an ed25519 sign per period per key
+        self._sign_cache: Dict[Tuple, Dict[str, Any]] = {}
+        # push-seed receiver state: hook fired once per doc id
+        self._seed_hook: Optional[Callable[[str], None]] = None
+        self._seeded: set = set()
         self.table = RoutingTable(self.id, k)
         self.records = RecordStore()
         self._plock = make_lock("net.dht.rpc")
@@ -390,6 +450,13 @@ class DhtNode:
         """Sign future announce records with the repo identity instead
         of the ephemeral node key (DhtSwarm.set_identity)."""
         self._announce_seed = seed
+        self._sign_cache = {}  # cached records carry the old key
+
+    def set_seed_hook(self, hook: Callable[[str], None]) -> None:
+        """Push-seed receiver (HM_DHT_PUSH_SEED): `hook(doc_id)` fires
+        once per doc named by a verified seed record addressed to this
+        node (Network wires backend.open — the node becomes a replica)."""
+        self._seed_hook = hook
 
     # -- inbound --------------------------------------------------------
 
@@ -442,8 +509,36 @@ class DhtNode:
         elif t == "announce":
             ok = self.records.put(msg.get("record"))
             self._send(addr, {"t": "stored", "rpc": rid, "ok": ok})
+        elif t == "seed":
+            ok = self._handle_seed(msg.get("record"))
+            self._send(addr, {"t": "stored", "rpc": rid, "ok": ok})
         elif t in ("pong", "nodes", "values", "stored"):
             self._resolve(rid, msg)
+
+    def _handle_seed(self, rec: Any) -> bool:
+        """A push-seed request landed (we are among the k closest to
+        the doc's key). Verify the signature AND that the named doc
+        really owns the record's keyspace position — a record may ask
+        us to replicate only the doc whose key it is stored under."""
+        if not verify_seed_record(rec):
+            return False
+        doc_id = str(rec["doc"])
+        from ...utils import keys as keymod
+
+        if rec["key"] != _id_hex(key_id(keymod.discovery_id(doc_id))):
+            return False
+        _M_SEEDS_RX.add(1)
+        hook = self._seed_hook
+        if hook is None or doc_id in self._seeded:
+            return True
+        self._seeded.add(doc_id)
+        # off the reader thread: opening a doc does storage I/O and
+        # may re-enter the network stack
+        threading.Thread(
+            target=lambda: hook(doc_id), daemon=True,
+            name=f"dht-seed:{doc_id[:6]}",
+        ).start()
+        return True
 
     def _node_triples(self, target: int) -> List[List[Any]]:
         return [
@@ -642,16 +737,49 @@ class DhtNode:
         host: str,
         port: int,
         ttl: Optional[float] = None,
+        seed_doc: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Publish a signed record for `key_hex` on the k nodes closest
         to it (plus our own store — a two-node fleet has no third party
-        to delegate to)."""
-        rec = make_record(key_hex, host, port, self._announce_seed, ttl)
+        to delegate to). An unchanged {key,host,port,ttl} re-publish
+        within the first half of the record's TTL window reuses the
+        cached signature (`dht.sign_cache_hits`) — the second half
+        re-signs so the record never expires out from under its
+        refresher. `seed_doc` push-seeds the doc to the same k-closest
+        targets (HM_DHT_PUSH_SEED), reusing the one walk."""
+        ck = (key_hex, host, int(port), ttl)
+        rec = self._sign_cache.get(ck)
+        if (
+            rec is not None
+            and time.time() < float(rec["ts"]) + float(rec["ttl"]) / 2
+        ):
+            _M_SIGN_CACHE.add(1)
+        else:
+            rec = make_record(
+                key_hex, host, port, self._announce_seed, ttl
+            )
+            self._sign_cache[ck] = rec
         self.records.put(rec)
         targets = self.find_node(int(key_hex, 16))
         for c in targets:
             self._send_rpc(c.addr, {"t": "announce", "record": rec})
         _M_ANNOUNCES.add(1)
+        if seed_doc is not None:
+            sk = ("seed", key_hex, seed_doc)
+            srec = self._sign_cache.get(sk)
+            if (
+                srec is None
+                or time.time() >= float(srec["ts"]) + float(srec["ttl"]) / 2
+            ):
+                srec = make_seed_record(
+                    key_hex, seed_doc, self._announce_seed, ttl
+                )
+                self._sign_cache[sk] = srec
+            else:
+                _M_SIGN_CACHE.add(1)
+            for c in targets:
+                self._send_rpc(c.addr, {"t": "seed", "record": srec})
+                _M_SEEDS_TX.add(1)
         return rec
 
     def bootstrap_now(self, timeout: Optional[float] = None) -> int:

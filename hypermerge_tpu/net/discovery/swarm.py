@@ -142,6 +142,12 @@ class DhtSwarm(Swarm):
         churn away the hook flips back and lookups resume."""
         self._need = fn
 
+    def set_seed_hook(self, fn) -> None:
+        """`fn(doc_id)`: a verified push-seed record landed on our DHT
+        node (HM_DHT_PUSH_SEED — we are among the doc key's k closest;
+        Network wires backend.open so this node becomes a replica)."""
+        self.node.set_seed_hook(fn)
+
     def join(
         self, discovery_id: str, options: JoinOptions = DEFAULT_JOIN
     ) -> None:
@@ -242,6 +248,16 @@ class DhtSwarm(Swarm):
                 looked_at.clear()
         with self._lock:
             joined = dict(self._joined)
+        # announce AGGREGATION (JoinOptions.via): every id joined via
+        # the same doc key folds into ONE group — one signed announce
+        # record and one lookup walk per doc per period, instead of
+        # one of each per placeholder actor feed. Replication
+        # negotiates the individual feeds over the connection the doc
+        # key produced, so nothing is lost — only O(actors) walks. Ids
+        # joined without a via keep their own key (legacy shape).
+        groups: Dict[str, List[Tuple[str, JoinOptions]]] = {}
+        for did, opts in joined.items():
+            groups.setdefault(opts.via or did, []).append((did, opts))
         now = time.monotonic()
         host, port = self.tcp.address
         # bounded work per pass: a doc whose cursor carries one
@@ -251,11 +267,23 @@ class DhtSwarm(Swarm):
         # verifies per store). Oldest-due first, the rest next pass —
         # the FIRST joined id (the doc being opened) always leads.
         due = []
-        for did, opts in joined.items():
-            if opts.announce and now >= announced_at.get(did, 0.0):
-                due.append((announced_at.get(did, 0.0), "a", did, opts))
-            if opts.lookup and now >= looked_at.get(did, 0.0):
-                if self._need is not None and not self._need(did):
+        for gkey, members in groups.items():
+            if (
+                any(o.announce for _d, o in members)
+                and now >= announced_at.get(gkey, 0.0)
+            ):
+                seed_doc = next(
+                    (o.seed for _d, o in members if o.seed is not None),
+                    None,
+                )
+                due.append(
+                    (announced_at.get(gkey, 0.0), "a", gkey, seed_doc)
+                )
+            lookers = [d for d, o in members if o.lookup]
+            if lookers and now >= looked_at.get(gkey, 0.0):
+                if self._need is not None and not any(
+                    self._need(d) for d in lookers
+                ):
                     # already replicating with someone: usually no
                     # walk, no dial — but every 10th period walk
                     # anyway. Two data-less peers that found only
@@ -263,35 +291,41 @@ class DhtSwarm(Swarm):
                     # ISLAND (with one-side dialing the lower-address
                     # data holder can never dial out); the slow-
                     # cadence shuffle is what merges islands.
-                    n_skip = skipped.get(did, 0) + 1
+                    n_skip = skipped.get(gkey, 0) + 1
                     if n_skip < 10:
-                        skipped[did] = n_skip
-                        looked_at[did] = now + lookup_s
+                        skipped[gkey] = n_skip
+                        looked_at[gkey] = now + lookup_s
                         continue
                     # do NOT reset the counter here: the budget below
                     # may defer this entry, and a reset-on-schedule
                     # would restart the 10-period clock without the
                     # walk ever running (the executed branch clears it)
-                due.append((looked_at.get(did, 0.0), "l", did, opts))
+                due.append((looked_at.get(gkey, 0.0), "l", gkey, lookers))
         due.sort(key=lambda e: e[0])
-        for _t, kind, did, opts in due[:_PASS_BUDGET]:
-            key = _id_hex(key_id(did))
+        for _t, kind, gkey, extra in due[:_PASS_BUDGET]:
+            key = _id_hex(key_id(gkey))
             if kind == "a":
-                self.node.announce(key, host, port)
-                announced_at[did] = time.monotonic() + announce_s
+                self.node.announce(key, host, port, seed_doc=extra)
+                announced_at[gkey] = time.monotonic() + announce_s
             else:
-                self._lookup_and_dial(did, key)
-                looked_at[did] = time.monotonic() + lookup_s
-                skipped.pop(did, None)  # the walk ran: island-shuffle
+                self._lookup_and_dial(gkey, key, extra)
+                looked_at[gkey] = time.monotonic() + lookup_s
+                skipped.pop(gkey, None)  # the walk ran: island-shuffle
                 # clock restarts only on an EXECUTED lookup
-        # joined ids that left drop their stamps
-        for table in (announced_at, looked_at):
-            for did in list(table):
-                if did not in joined:
-                    table.pop(did, None)
+        # group keys whose members all left drop their stamps + view
+        for table in (announced_at, looked_at, skipped):
+            for gkey in list(table):
+                if gkey not in groups:
+                    table.pop(gkey, None)
+        with self._lock:
+            for gkey in list(self._targets):
+                if gkey not in groups:
+                    self._targets.pop(gkey, None)
         return len(due) > _PASS_BUDGET
 
-    def _lookup_and_dial(self, did: str, key: str) -> None:
+    def _lookup_and_dial(
+        self, gkey: str, key: str, members: List[str]
+    ) -> None:
         records = self.node.lookup(key)
         own_addr = tuple(self.tcp.address)
         addrs = []
@@ -312,7 +346,7 @@ class DhtSwarm(Swarm):
             return
         n = _targets_n()
         with self._lock:
-            current = self._targets.get(did, ())
+            current = self._targets.get(gkey, ())
             active = {a for t in self._targets.values() for a in t}
         # the bounded active view is STABLE and SHARED: keep targets
         # still being announced, and cover any deficit FIRST from
@@ -334,9 +368,9 @@ class DhtSwarm(Swarm):
             pool = self._rng.sample(pool, deficit)
         view = keep + take + pool
         with self._lock:
-            if did not in self._joined:
+            if not any(d in self._joined for d in members):
                 return  # leave() raced the lookup: no dials
-            self._targets[did] = tuple(view)
+            self._targets[gkey] = tuple(view)
         for addr in pool:
             try:
                 self.tcp.connect(addr)
@@ -350,7 +384,11 @@ class DhtSwarm(Swarm):
         --dht, tools/ls.py header, bench config_swarm)."""
         with self._lock:
             joined = {
-                did: {"announce": o.announce, "lookup": o.lookup}
+                did: {
+                    "announce": o.announce,
+                    "lookup": o.lookup,
+                    **({"via": o.via} if o.via else {}),
+                }
                 for did, o in self._joined.items()
             }
             targets = {did: len(t) for did, t in self._targets.items()}

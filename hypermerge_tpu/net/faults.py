@@ -384,6 +384,12 @@ class FaultSwarm(Swarm):
         if inner is not None:
             inner(fn)
 
+    def set_seed_hook(self, fn) -> None:
+        """Push-seed receiver passthrough (DhtSwarm under faults)."""
+        inner = getattr(self.inner, "set_seed_hook", None)
+        if inner is not None:
+            inner(fn)
+
     def discovery_report(self):
         """DHT introspection passthrough (DhtSwarm under faults)."""
         fn = getattr(self.inner, "discovery_report", None)

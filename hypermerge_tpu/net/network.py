@@ -10,12 +10,13 @@ sync rides "Replication" (net/replication.py).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 from typing import Any, Dict, Optional, Set
 
 from ..analysis.lockdep import make_rlock
-from .. import msgs
+from .. import msgs, telemetry
 from ..crdt import clock as clockmod
 from ..utils.debug import log
 from .connection import PeerConnection
@@ -25,6 +26,24 @@ from .replication import ReplicationManager
 from .swarm import DEFAULT_JOIN, ConnectionDetails, JoinOptions, Swarm
 
 MSGS_CHANNEL = "Msgs"
+
+# delta cursor gossip (HM_CURSOR_DELTA): steady-state frame sizes.
+# full_tx counts whole-map frames (first frame per connection+doc and
+# every repair-path send), delta_tx counts advanced-actors-only frames,
+# suppressed counts gossip rounds skipped entirely because nothing
+# advanced since the last frame this connection acked into the ledger.
+_M_CUR_FULL = telemetry.counter("net.cursor.full_tx")
+_M_CUR_DELTA = telemetry.counter("net.cursor.delta_tx")
+_M_CUR_SUPPRESSED = telemetry.counter("net.cursor.suppressed")
+
+
+def _cursor_delta_on() -> bool:
+    """Delta cursor frames: steady-state gossip sends only the actors
+    whose clock advanced since the last frame sent on this connection
+    (full frame on (re)connect). Receiver-safe by construction — the
+    receive path merges max-wins/union, so a partial map is just a
+    small merge. =0 keeps the full-frame twin bit-compatible."""
+    return os.environ.get("HM_CURSOR_DELTA", "1") == "1"
 
 
 class Network:
@@ -96,13 +115,24 @@ class Network:
             set_need(
                 lambda did: not self.replication.peers_with_feed(did)
             )
+        # push-seed receiver (HM_DHT_PUSH_SEED): a verified seed record
+        # from the DHT names a doc this node is among the k-closest
+        # for — open it so the creator stops serving the entire
+        # cold-join first wave alone
+        set_seed = getattr(swarm, "set_seed_hook", None)
+        opener = getattr(self.backend, "open", None)
+        if set_seed is not None and opener is not None:
+            set_seed(opener)
         swarm.on_connection(self._on_connection)
         for did in self.backend.feeds.known_discovery_ids():
             self.join(did)
         for did in list(self.pending_joins):
             self.join(did)
 
-    def join(self, discovery_id: str) -> None:
+    def join(
+        self, discovery_id: str,
+        options: Optional[JoinOptions] = None,
+    ) -> None:
         if self.swarm is None:
             self.pending_joins.add(discovery_id)
             return
@@ -110,7 +140,7 @@ class Network:
             if discovery_id in self.joined:
                 return
             self.joined.add(discovery_id)
-        self.swarm.join(discovery_id, self.join_options)
+        self.swarm.join(discovery_id, options or self.join_options)
 
     def leave(self, discovery_id: str) -> None:
         with self._lock:
@@ -264,8 +294,33 @@ class Network:
     # outbound (called by RepoBackend)
 
     def announce_feed(self, feed) -> None:
-        self.join(feed.discovery_id)
+        self.join(feed.discovery_id, self._feed_join_options(feed))
         self.replication.announce(feed)
+
+    def _feed_join_options(self, feed) -> Optional[JoinOptions]:
+        """Announce aggregation: a feed that belongs to a known doc
+        joins the DHT VIA the doc's discovery id — one signed record
+        per doc key instead of one per placeholder actor feed (the
+        O(actors) announce walks PR 15 measured). Push-seeding
+        (HM_DHT_PUSH_SEED) rides the same options. None = no doc
+        association known here; the feed announces under its own key."""
+        cursors = getattr(self.backend, "cursors", None)
+        if cursors is None:
+            return None
+        from ..utils import keys as keymod
+
+        docs = sorted(
+            cursors.docs_with_actor(self.backend.id, feed.public_key)
+        )
+        if not docs:
+            return None
+        doc_id = docs[0]  # deterministic pick for multi-doc actors
+        opts = dataclasses.replace(
+            self.join_options, via=keymod.discovery_id(doc_id)
+        )
+        if os.environ.get("HM_DHT_PUSH_SEED", "0") == "1":
+            opts = dataclasses.replace(opts, seed=doc_id)
+        return opts
 
     def _peers_for_doc(self, doc_id: str) -> Set[NetworkPeer]:
         from ..utils import keys as keymod
@@ -279,22 +334,76 @@ class Network:
         return peers
 
     def send_cursor_to(self, peer: NetworkPeer, doc_id: str,
-                       cursor: clockmod.Clock, clock: clockmod.Clock) -> None:
-        peer.try_send(
+                       cursor: clockmod.Clock, clock: clockmod.Clock,
+                       full: bool = True) -> None:
+        """Send a cursor frame to one peer. `full=True` (the repair
+        paths: discovery replies, anti-entropy sweeps) always carries
+        the whole maps; `full=False` (steady-state gossip) sends a
+        delta against this connection's send ledger when
+        HM_CURSOR_DELTA is on — or nothing at all when no actor
+        advanced since the last frame."""
+        conn = peer.connection  # snapshot: ledger rides the connection
+        # (a replacement connection starts with no ledger, so the
+        # first frame after churn is full — the resync guarantee)
+        use_delta = not full and _cursor_delta_on() and conn is not None
+        msg_cursor, msg_clock = cursor, clock
+        if use_delta:
+            with self._lock:
+                ledger = getattr(conn, "_hm_cursor_sent", None)
+                sent = None if ledger is None else ledger.get(doc_id)
+                if sent is not None:
+                    s_cur, s_clk = sent
+                    msg_cursor = {
+                        k: v for k, v in cursor.items()
+                        if s_cur.get(k, -1) < v
+                    }
+                    msg_clock = {
+                        k: v for k, v in clock.items()
+                        if s_clk.get(k, -1) < v
+                    }
+            if sent is None:
+                msg_cursor, msg_clock = cursor, clock
+                use_delta = False  # first frame per conn+doc is full
+            elif not msg_cursor and not msg_clock:
+                _M_CUR_SUPPRESSED.add(1)
+                return
+        ok = peer.try_send(
             MSGS_CHANNEL,
             msgs.cursor_message(
                 doc_id,
-                clockmod.clock_to_strs(cursor),
-                clockmod.clock_to_strs(clock),
+                clockmod.clock_to_strs(msg_cursor),
+                clockmod.clock_to_strs(msg_clock),
             ),
         )
+        if not ok:
+            return  # dropped to churn; the replacement resyncs full
+        (_M_CUR_DELTA if use_delta else _M_CUR_FULL).add(1)
+        if not _cursor_delta_on() or conn is None:
+            return
+        # ledger merge (max-wins, like the receiver): record the FULL
+        # new maps — the peer now knows at least this much, whether
+        # the frame carried all of it or just the advancing slice
+        with self._lock:
+            ledger = getattr(conn, "_hm_cursor_sent", None)
+            if ledger is None:
+                ledger = {}
+                conn._hm_cursor_sent = ledger
+            s_cur, s_clk = ledger.get(doc_id, ({}, {}))
+            ns_cur, ns_clk = dict(s_cur), dict(s_clk)
+            for k, v in cursor.items():
+                if ns_cur.get(k, -1) < v:
+                    ns_cur[k] = v
+            for k, v in clock.items():
+                if ns_clk.get(k, -1) < v:
+                    ns_clk[k] = v
+            ledger[doc_id] = (ns_cur, ns_clk)
 
     def gossip_cursor(
         self, doc_id: str, cursor: clockmod.Clock, clock: clockmod.Clock
     ) -> None:
         peers = self.gossip.sample(doc_id, list(self._peers_for_doc(doc_id)))
         for peer in peers:
-            self.send_cursor_to(peer, doc_id, cursor, clock)
+            self.send_cursor_to(peer, doc_id, cursor, clock, full=False)
 
     def broadcast_doc_message(self, doc_id: str, contents: Any) -> None:
         # deliberately UNSAMPLED: ephemeral doc messages are one-shot

@@ -113,6 +113,9 @@ class Session:
     def kick(self) -> None:
         """Skip the current backoff sleep (idempotent re-`connect`)."""
         self._wake.set()
+        hook = getattr(self, "_kick_hook", None)
+        if hook is not None:  # async mode: cancel the backoff timer
+            hook()
 
 
 class SessionSupervisor:
@@ -121,19 +124,32 @@ class SessionSupervisor:
     `dial(address)` must return a CONNECTED duplex (handshake done) or
     raise OSError; `deliver(duplex, details)` hands the connection to
     the swarm's on_connection callback. `banned(address)` lets the
-    swarm veto an address (see TcpSwarm's ban registry)."""
+    swarm veto an address (see TcpSwarm's ban registry).
+
+    Async mode (`HM_NET_ASYNC=1`): pass `connector` (the shared
+    net/aio.py loop, or anything with `call_soon`/`call_later`) and a
+    `dial(address, cb)` primitive that starts a NON-blocking dial and
+    fires `cb(duplex, exc)` exactly once when the handshake settles.
+    Sessions then run as callback state machines — the same
+    CONNECTING/CONNECTED/BACKOFF/STOPPED transitions, counters and
+    ban/reconnect consults as the thread mode, but a supervised
+    address no longer owns a parked thread: backoff waits live on the
+    loop's timer wheel, so 1000 supervised peers cost 1000 heap
+    entries instead of 1000 threads."""
 
     def __init__(
         self,
-        dial: Callable[[Any], Any],
+        dial: Callable[..., Any],
         deliver: Callable[[Any, Any], None],
         banned: Optional[Callable[[Any], bool]] = None,
         on_status: Optional[Callable[[Session, str, dict], None]] = None,
+        connector: Optional[Any] = None,
     ) -> None:
         self._dial = dial
         self._deliver = deliver
         self._banned = banned if banned is not None else lambda a: False
         self._on_status = on_status
+        self._connector = connector
         self._lock = make_rlock("net.sup")
         self._sessions: Dict[Any, Session] = {}
         self._stopped = False
@@ -184,6 +200,14 @@ class SessionSupervisor:
             # rather than silence.
             s = Session(address)
             self._sessions[address] = s
+        if self._connector is not None:
+            # async mode: no parked thread — the session advances via
+            # dial callbacks and loop timers
+            s._dialing = False
+            s._timer = None
+            s._kick_hook = lambda: self._a_kick(s)
+            self._a_attempt(s)
+            return s
         t = threading.Thread(
             target=self._run, args=(s,), daemon=True,
             name=f"redial:{address}",
@@ -205,6 +229,12 @@ class SessionSupervisor:
             done = getattr(s, "_conn_done", None)
             if done is not None:
                 done.set()
+            # async sessions have no thread to observe _stopped and
+            # retire themselves: the kick above cancelled the backoff
+            # timer, so finish the transition here (the callback chain
+            # re-checks _stopped before any further step)
+            if self._connector is not None and s.state != STOPPED:
+                self._stop_session(s, "supervisor stopped")
         # bounded join before retiring the series: a session thread
         # bumping `dials` after the fold would land on a dropped
         # handle (kick() already interrupts backoff sleeps; only a
@@ -240,6 +270,116 @@ class SessionSupervisor:
         s.stop_reason = reason
         self._status(s, STOPPED, reason=reason)
         log("net:redial", f"session {s.address} stopped: {reason}")
+
+    # ------------------------------------------------------------------
+    # async session state machine (connector mode): one step per
+    # callback, mirroring _run()'s sequence exactly — same consults,
+    # same counter points, same "details exposed after deliver" rule
+
+    def _a_kick(self, s: Session) -> None:
+        t = getattr(s, "_timer", None)
+        if t is not None:
+            t.cancel()
+        if s.state == BACKOFF and not self._stopped:
+            self._connector.call_soon(lambda: self._a_attempt(s))
+
+    def _a_attempt(self, s: Session) -> None:
+        with self._lock:
+            if self._stopped or s.state == STOPPED or s._dialing:
+                return
+            s._dialing = True
+        if self._banned(s.address):
+            s._dialing = False
+            self._stop_session(s, "banned address")
+            return
+        # re-consult the stop signals set during a backoff window
+        # (same rule as the thread loop's top-of-iteration check)
+        d = s.details
+        if d is not None:
+            if d.banned:
+                s._dialing = False
+                self._stop_session(s, "peer banned")
+                return
+            if not d._reconnect_allowed:
+                s._dialing = False
+                self._stop_session(s, "reconnect disallowed")
+                return
+        self._status(s, CONNECTING, attempt=s.backoff.attempt)
+        self._m["dials"].add(1)
+        try:
+            self._dial(
+                s.address,
+                lambda duplex, exc: self._a_dialed(s, duplex, exc),
+            )
+        except OSError as e:
+            self._a_failed(s, e)
+
+    def _a_failed(self, s: Session, e: BaseException) -> None:
+        s._dialing = False
+        if self._stopped:
+            return
+        s.failures += 1
+        delay = s.backoff.next_delay()
+        self._status(
+            s, BACKOFF, error=str(e), delay=delay,
+            attempt=s.backoff.attempt,
+        )
+        s._timer = self._connector.call_later(
+            delay, lambda: self._a_attempt(s)
+        )
+
+    def _a_dialed(self, s: Session, duplex: Any, exc) -> None:
+        if exc is not None:
+            self._a_failed(s, exc)
+            return
+        s._dialing = False
+        if self._stopped or self._banned(s.address):
+            # stop()/ban landed while the dial was in flight: never
+            # hand a live connection to a torn-down swarm
+            duplex.close()
+            if self._stopped:
+                return
+            self._stop_session(s, "banned address")
+            return
+        from .swarm import ConnectionDetails
+
+        details = ConnectionDetails(client=True)
+        s.duplex = duplex
+        t_up = time.monotonic()
+        s.connects += 1
+        if s.connects > 1:
+            self._m["reconnects"].add(1)
+        self._status(s, CONNECTED, connects=s.connects)
+        try:
+            self._deliver(duplex, details)
+        except Exception as e:  # callback bug: treat as a drop
+            log("net:redial", f"deliver failed for {s.address}: {e}")
+            duplex.close()
+        # expose the details only once deliver wired its hooks
+        s.details = details
+        # register AFTER deliver: the stack's own close listeners run
+        # (peer inactive -> replication reset) before the redial
+        duplex.on_close(lambda: self._a_closed(s, details, t_up))
+
+    def _a_closed(self, s: Session, details: Any, t_up: float) -> None:
+        if self._stopped:
+            return
+        if details.banned:
+            self._stop_session(s, "peer banned")
+            return
+        if not details._reconnect_allowed:
+            self._stop_session(s, "reconnect disallowed")
+            return
+        if time.monotonic() - t_up >= _reset_uptime_s():
+            s.backoff.reset()  # a STABLE connection earns the fast
+            # first redial; instant drops keep escalating
+        delay = s.backoff.next_delay()
+        self._status(
+            s, BACKOFF, delay=delay, attempt=s.backoff.attempt
+        )
+        s._timer = self._connector.call_later(
+            delay, lambda: self._a_attempt(s)
+        )
 
     def _run(self, s: Session) -> None:
         while not self._stopped:

@@ -24,10 +24,20 @@ class JoinOptions:
     Network.ts:22 — the repo's swarm posture): `announce` makes a
     joined id discoverable by peers looking it up; `lookup` actively
     seeks announcers. Server-ish peers announce, clients look up;
-    default is both."""
+    default is both.
+
+    `via` is the announce-aggregation key (HM discovery ids only): a
+    feed id joined with via=<doc discovery id> is announced and looked
+    up under ONE signed DHT record per doc key instead of one per
+    placeholder actor feed — peers of the doc find each other through
+    the doc key, and replication negotiates the individual feeds over
+    the connection. `seed` optionally names the doc id to push-seed to
+    the DHT's k-closest at announce time (HM_DHT_PUSH_SEED)."""
 
     announce: bool = True
     lookup: bool = True
+    via: Optional[str] = None
+    seed: Optional[str] = None
 
 
 DEFAULT_JOIN = JoinOptions()

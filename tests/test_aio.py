@@ -1,0 +1,464 @@
+"""Selector-based async transport (net/aio.py, HM_NET_ASYNC=1): the
+thread-per-connection stack's bit-compatible twin on ONE loop thread.
+
+What the 1000-peer claim rests on, verified here at CI scale:
+
+- the Duplex contract holds over the loop (roundtrip, buffering,
+  close listeners, shed policy, keepalive half-open detection);
+- the two stacks interoperate ON THE WIRE in either direction,
+  identity auth included (the =0/=1 twin seam);
+- a 50-daemon fleet costs O(daemons + pool) threads, not
+  O(connections x 4) — the thread-census regression test;
+- the legacy stack's accept path is a BOUNDED handshake pool, not a
+  thread per accepted socket (the tcp.py accept-storm fix);
+- the async supervisor state machine (dial/backoff/redial with no
+  parked session thread) survives failed dials and mid-burst drops;
+- seeded kill/heal chaos over FaultDuplex-wrapped aio transports
+  reconverges bit-identically to an unfaulted loopback twin, across
+  HM_CURSOR_DELTA x HM_NET_ASYNC env combinations (the delta-cursor
+  fuzz + the chaos matrix over aio).
+
+Runs fully instrumented: the lockdep + racedep module fixtures verify
+the net.aio / net.aio.conn / net.aio.dispatch / net.tcp.accept lock
+classes and the AioLoop/AioDuplex guard-manifest rows against real
+churn."""
+
+import socket as sockmod
+import threading
+import time
+
+import pytest
+
+from hypermerge_tpu import telemetry
+from hypermerge_tpu.net.aio import AioDuplex, get_loop
+from hypermerge_tpu.net.faults import FaultPlan, FaultSwarm
+from hypermerge_tpu.net.resilience import BACKOFF, CONNECTING, STOPPED
+from hypermerge_tpu.net.tcp import TcpDuplex, TcpSwarm
+from hypermerge_tpu.repo import Repo
+from hypermerge_tpu.utils import base58, crypto
+
+from helpers import wait_until
+from lockdep_fixture import lockdep_suite
+from racedep_fixture import racedep_suite
+
+_lockdep_suite = lockdep_suite()
+_racedep_suite = racedep_suite()
+
+
+@pytest.fixture
+def fast_redial(monkeypatch):
+    monkeypatch.setenv("HM_REDIAL_BASE_MS", "20")
+    monkeypatch.setenv("HM_REDIAL_MAX_S", "0.25")
+
+
+def _counter(name):
+    return telemetry.snapshot().get(name, 0)
+
+
+def _tcp_pair():
+    """A real accepted TCP socket pair (socketpair lacks getpeername
+    quirks some paths hit)."""
+    srv = sockmod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    c = sockmod.socket()
+    c.connect(srv.getsockname())
+    s, _ = srv.accept()
+    srv.close()
+    return c, s
+
+
+class TestAioDuplex:
+    def test_roundtrip_both_directions(self):
+        a, b = sockmod.socketpair()
+        da = AioDuplex(a, is_client=True)
+        db = AioDuplex(b, is_client=False)
+        got_a, got_b = [], []
+        da.on_message(got_a.append)
+        db.on_message(got_b.append)
+        da.send({"n": 1})
+        da.send({"n": 2})
+        db.send({"r": 3})
+        wait_until(lambda: got_b == [{"n": 1}, {"n": 2}])
+        wait_until(lambda: got_a == [{"r": 3}])
+        da.close()
+        wait_until(lambda: db.closed)
+
+    def test_rx_buffers_until_subscribe(self):
+        """utils.queue.Queue contract: frames arriving before the
+        subscriber registers are buffered, then delivered in order."""
+        a, b = sockmod.socketpair()
+        da = AioDuplex(a, is_client=True)
+        db = AioDuplex(b, is_client=False)
+        for i in range(5):
+            da.send({"i": i})
+        time.sleep(0.3)  # frames land before anyone subscribes
+        got = []
+        db.on_message(got.append)
+        wait_until(lambda: got == [{"i": i} for i in range(5)])
+        da.close()
+        db.close()
+
+    def test_identity_auth_pins_peer(self):
+        import os
+
+        seed_a = os.urandom(32)
+        seed_b = os.urandom(32)
+        ready = []
+        a, b = _tcp_pair()
+        da = AioDuplex(
+            a, is_client=True, identity=seed_a,
+            on_ready=lambda d, e: ready.append(("a", e)),
+        )
+        db = AioDuplex(
+            b, is_client=False, identity=seed_b,
+            on_ready=lambda d, e: ready.append(("b", e)),
+        )
+        wait_until(lambda: len(ready) == 2)
+        assert all(e is None for _s, e in ready), ready
+        assert da.peer_identity == base58.encode(
+            crypto.public_key(seed_b)
+        )
+        assert db.peer_identity == base58.encode(
+            crypto.public_key(seed_a)
+        )
+        da.close()
+        db.close()
+
+    def test_interop_with_tcp_duplex_both_roles(self):
+        """Bit-compatibility on the wire: a loop-driven endpoint talks
+        to a thread-per-connection endpoint, with identity auth, in
+        BOTH role assignments."""
+        import os
+
+        for aio_is_client in (True, False):
+            seed_a = os.urandom(32)
+            seed_t = os.urandom(32)
+            c, s = _tcp_pair()
+            ready = []
+            da = AioDuplex(
+                c if aio_is_client else s,
+                is_client=aio_is_client,
+                identity=seed_a,
+                on_ready=lambda d, e: ready.append(e),
+            )
+            dt = TcpDuplex(
+                s if aio_is_client else c,
+                is_client=not aio_is_client,
+                identity=seed_t,
+            )
+            wait_until(lambda: ready == [None])
+            got_a, got_t = [], []
+            da.on_message(got_a.append)
+            dt.on_message(got_t.append)
+            da.send({"from": "aio"})
+            dt.send({"from": "tcp"})
+            wait_until(lambda: got_t == [{"from": "aio"}])
+            wait_until(lambda: got_a == [{"from": "tcp"}])
+            assert da.peer_identity == base58.encode(
+                crypto.public_key(seed_t)
+            )
+            assert dt.peer_identity == base58.encode(
+                crypto.public_key(seed_a)
+            )
+            da.close()
+            wait_until(lambda: dt.closed)
+
+    def test_close_fires_listeners_and_retires_gauge(self):
+        before = _counter("net.aio.conns")
+        a, b = sockmod.socketpair()
+        da = AioDuplex(a, is_client=True)
+        db = AioDuplex(b, is_client=False)
+        wait_until(lambda: _counter("net.aio.conns") == before + 2)
+        closed = []
+        db.on_close(lambda: closed.append(True))
+        da.close()
+        wait_until(lambda: db.closed and closed == [True])
+        wait_until(lambda: _counter("net.aio.conns") == before)
+        # registering after close fires immediately (TcpDuplex rule)
+        late = []
+        db.on_close(lambda: late.append(True))
+        assert late == [True]
+
+    def test_non_draining_peer_sheds_connection(self, monkeypatch):
+        """Same shed policy as TcpDuplex: past the outbox cap with a
+        stalled peer the connection sheds instead of growing forever —
+        and the loop thread stays responsive for OTHER connections."""
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_TCP_OUTBOX_MB", "0.01")  # ~10 KB
+        monkeypatch.setenv("HM_TCP_STALL_S", "0.2")
+        a, b = sockmod.socketpair()
+        a.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_SNDBUF, 4096)
+        b.setsockopt(sockmod.SOL_SOCKET, sockmod.SO_RCVBUF, 4096)
+        d = AioDuplex(a)
+        # a healthy bystander pair on the SAME loop
+        c1, c2 = sockmod.socketpair()
+        h1, h2 = AioDuplex(c1), AioDuplex(c2)
+        got = []
+        h2.on_message(got.append)
+        payload = {"pad": "x" * 4096}
+        deadline = time.time() + 10
+        while not d.closed and time.time() < deadline:
+            d.send(payload)
+        assert d.closed, "outbox grew past the cap without shedding"
+        h1.send({"still": "alive"})
+        wait_until(lambda: got == [{"still": "alive"}])
+        b.close()
+        h1.close()
+        h2.close()
+
+    def test_keepalive_sheds_half_open(self, monkeypatch):
+        """The timer-wheel keepalive detects a silent peer within
+        2 * HM_NET_PING_S * HM_NET_PING_MISSES — no thread per duplex."""
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0.2")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "2")
+        a, b = sockmod.socketpair()
+        t0 = time.monotonic()
+        d = AioDuplex(a)
+        wait_until(lambda: d.closed, timeout=5)
+        assert time.monotonic() - t0 <= 2 * 0.2 * 2 + 0.5
+        b.close()
+
+    def test_healthy_idle_pair_stays_up(self, monkeypatch):
+        monkeypatch.setenv("HM_TCP_PLAINTEXT", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0.15")
+        monkeypatch.setenv("HM_NET_PING_MISSES", "1")
+        a, b = sockmod.socketpair()
+        da, db = AioDuplex(a), AioDuplex(b)
+        got = []
+        db.on_message(got.append)
+        time.sleep(1.0)  # ~7 ping periods, miss budget 1
+        assert not da.closed and not db.closed
+        assert got == []  # keepalive frames never reach subscribers
+        da.send({"still": "works"})
+        wait_until(lambda: got == [{"still": "works"}])
+        da.close()
+        db.close()
+
+
+class TestAsyncSwarm:
+    def test_two_repos_over_async_tcp(self, monkeypatch):
+        monkeypatch.setenv("HM_NET_ASYNC", "1")
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"over": "aio"})
+        assert rb.open(url).value(timeout=10) == {"over": "aio"}
+        rb.change(url, lambda d: d.__setitem__("back", True))
+        wait_until(lambda: ra.doc(url).get("back") is True)
+        ra.close()
+        rb.close()
+        sa.destroy()
+        sb.destroy()
+
+    def test_async_and_legacy_swarms_interoperate(self, monkeypatch):
+        """The =0 / =1 twins are bit-compatible END TO END: a legacy
+        swarm and an async swarm converge a doc in both directions."""
+        monkeypatch.setenv("HM_NET_ASYNC", "0")
+        sa = TcpSwarm()  # legacy listener
+        monkeypatch.setenv("HM_NET_ASYNC", "1")
+        sb = TcpSwarm()  # async dialer
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"mode": "mixed"})
+        assert rb.open(url).value(timeout=10) == {"mode": "mixed"}
+        rb.change(url, lambda d: d.__setitem__("ok", 1))
+        wait_until(lambda: ra.doc(url).get("ok") == 1)
+        ra.close()
+        rb.close()
+        sa.destroy()
+        sb.destroy()
+
+    def test_fifty_daemon_thread_census(self, monkeypatch):
+        """THE regression test for the tentpole: 50 dialing swarms plus
+        one listener, 100 live connections, and the process pays
+        O(daemons + pool) threads — one accepter per swarm, one shared
+        loop, a bounded dispatch pool — NOT O(connections x 4). A
+        supervised session owns no parked thread either."""
+        monkeypatch.setenv("HM_NET_ASYNC", "1")
+        monkeypatch.setenv("HM_NET_PING_S", "0")  # census, not liveness
+        n = 50
+        get_loop()  # pre-created so the census counts swarm cost only
+        t0 = threading.active_count()
+        conns0 = _counter("net.aio.conns")
+        central = TcpSwarm()
+        clients = [TcpSwarm() for _ in range(n)]
+        try:
+            for c in clients:
+                c.connect(central.address)
+            wait_until(
+                lambda: len(central._duplexes) == n
+                and all(len(c._duplexes) == 1 for c in clients),
+                timeout=60,
+            )
+            assert _counter("net.aio.conns") >= conns0 + 2 * n
+            # (n+1) accept threads + dispatch pool + slack; the legacy
+            # stack would sit at >= 4 threads per connection here
+            delta = threading.active_count() - t0
+            assert delta <= (n + 1) + 12, (
+                f"{delta} new threads for {n} daemons"
+            )
+            # async sessions park no thread (the `_thread` attr is the
+            # legacy redial loop's)
+            for c in clients:
+                for s in c.supervisor.sessions():
+                    assert getattr(s, "_thread", None) is None
+        finally:
+            central.destroy()
+            for c in clients:
+                c.destroy()
+        wait_until(
+            lambda: _counter("net.aio.conns") <= conns0, timeout=30
+        )
+
+    def test_accept_storm_bounded_thread_pool(self, monkeypatch):
+        """tcp.py legacy accept path regression: a storm of 30
+        non-handshaking sockets parks in the bounded pool's queue
+        (HM_TCP_ACCEPT_POOL) instead of spawning 30 handshake
+        threads."""
+        monkeypatch.setenv("HM_NET_ASYNC", "0")
+        sw = TcpSwarm()
+        t0 = threading.active_count()
+        socks = []
+        try:
+            for _ in range(30):
+                c = sockmod.socket()
+                c.connect(sw.address)
+                socks.append(c)
+            deadline = time.time() + 2
+            worst = 0
+            while time.time() < deadline:
+                worst = max(worst, threading.active_count() - t0)
+                time.sleep(0.05)
+            assert worst <= 8 + 3, (
+                f"{worst} threads spawned by a 30-socket accept storm"
+            )
+        finally:
+            for c in socks:
+                c.close()
+            sw.destroy()
+
+
+class TestAsyncSupervisor:
+    def test_failed_dial_backs_off_and_retries(
+        self, fast_redial, monkeypatch
+    ):
+        monkeypatch.setenv("HM_NET_ASYNC", "1")
+        port = sockmod.socket()
+        port.bind(("127.0.0.1", 0))
+        dead = port.getsockname()
+        port.close()  # nothing listens here
+        sw = TcpSwarm()
+        try:
+            s = sw.connect(dead)
+            wait_until(lambda: sw.supervisor.stats["dials"] >= 2)
+            assert s.failures >= 1
+            assert s.state in (BACKOFF, CONNECTING)
+        finally:
+            sw.destroy()
+        assert s.state == STOPPED
+
+    def test_redial_after_drop_resumes_replication(
+        self, fast_redial, monkeypatch
+    ):
+        monkeypatch.setenv("HM_NET_ASYNC", "1")
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa, sb = TcpSwarm(), TcpSwarm()
+        ra.set_swarm(sa)
+        rb.set_swarm(sb)
+        sb.connect(sa.address)
+        url = ra.create({"v": 1})
+        assert rb.open(url).value(timeout=10)["v"] == 1
+        for d in list(sb._duplexes):  # hard-drop b's transports
+            d.close()
+        ra.change(url, lambda d: d.__setitem__("v", 2))
+        # the supervised session redials on its own — no connect() here
+        wait_until(lambda: rb.doc(url).get("v") == 2, timeout=20)
+        assert sb.supervisor.stats["reconnects"] >= 1
+        ra.close()
+        rb.close()
+        sa.destroy()
+        sb.destroy()
+
+
+def _apply_script(repo_a, repo_b, url, lo, hi):
+    for i in range(lo, hi):
+        repo_a.change(url, lambda d, i=i: d["a"].append(i))
+        repo_b.change(url, lambda d, i=i: d["b"].append(i))
+
+
+def _loopback_twin_state(n_total):
+    """The converged state an UNFAULTED, legacy-transport pair reaches
+    on the same edit script — the bit-identical oracle."""
+    from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+
+    hub = LoopbackHub()
+    ra, rb = Repo(memory=True), Repo(memory=True)
+    ra.set_swarm(LoopbackSwarm(hub))
+    rb.set_swarm(LoopbackSwarm(hub))
+    url = ra.create({"a": [], "b": []})
+    assert rb.open(url).value(timeout=10) is not None
+    _apply_script(ra, rb, url, 0, n_total)
+    want = {"a": list(range(n_total)), "b": list(range(n_total))}
+    wait_until(lambda: ra.doc(url) == want and rb.doc(url) == want)
+    state = ra.doc(url)
+    ra.close()
+    rb.close()
+    return state
+
+
+class TestChaosMatrixOverAio:
+    """Seeded kill/heal chaos (the existing FaultPlan schedules) across
+    the HM_CURSOR_DELTA x HM_NET_ASYNC matrix — the (0,0) cell is
+    tests/test_chaos.py. FaultDuplex wraps the aio transport through
+    the same public Duplex surface it wraps TcpDuplex through."""
+
+    @pytest.mark.parametrize(
+        "delta,asyncm", [("1", "1"), ("0", "1"), ("1", "0")]
+    )
+    def test_kill_heal_reconverges_bit_identical(
+        self, delta, asyncm, fast_redial, monkeypatch
+    ):
+        monkeypatch.setenv("HM_CURSOR_DELTA", delta)
+        monkeypatch.setenv("HM_NET_ASYNC", asyncm)
+        cur0 = (
+            _counter("net.cursor.delta_tx")
+            + _counter("net.cursor.suppressed")
+        )
+        plan = FaultPlan(seed=11, events=[(1, "kill"), (2, "heal")])
+        ra, rb = Repo(memory=True), Repo(memory=True)
+        sa = TcpSwarm()
+        fb = FaultSwarm(TcpSwarm(), plan)
+        ra.set_swarm(sa)
+        rb.set_swarm(fb)
+        fb.connect(sa.address)
+        url = ra.create({"a": [], "b": []})
+        assert rb.open(url).value(timeout=10) is not None
+        n1, n2, n3 = 4, 4, 4
+        _apply_script(ra, rb, url, 0, n1)  # healthy phase
+        fb.tick()  # kill
+        wait_until(lambda: plan.down)
+        _apply_script(ra, rb, url, n1, n1 + n2)  # partitioned edits
+        fb.tick()  # heal: the supervised redial goes through
+        _apply_script(ra, rb, url, n1 + n2, n1 + n2 + n3)
+        monkeypatch.setenv("HM_NET_ASYNC", "0")  # oracle on legacy
+        want = _loopback_twin_state(n1 + n2 + n3)
+        wait_until(
+            lambda: ra.doc(url) == want and rb.doc(url) == want,
+            timeout=60,
+        )
+        if delta == "1":
+            # steady-state gossip actually ran in delta mode
+            assert (
+                _counter("net.cursor.delta_tx")
+                + _counter("net.cursor.suppressed")
+            ) > cur0
+        ra.close()
+        rb.close()
+        sa.destroy()
+        fb.destroy()

@@ -25,9 +25,14 @@ from hypermerge_tpu.net.discovery import (
     make_record,
     verify_record,
 )
-from hypermerge_tpu.net.discovery.dht import Contact, _id_hex
+from hypermerge_tpu.net.discovery.dht import (
+    Contact,
+    _id_hex,
+    make_seed_record,
+    verify_seed_record,
+)
 from hypermerge_tpu.net.faults import FaultPlan, FaultSwarm
-from hypermerge_tpu.net.swarm import LoopbackHub, LoopbackSwarm
+from hypermerge_tpu.net.swarm import JoinOptions, LoopbackHub, LoopbackSwarm
 from hypermerge_tpu.repo import Repo
 
 from helpers import wait_until
@@ -98,6 +103,24 @@ class TestRecords:
         assert not store.put({"key": "junk"})
         assert not store.put(None)
         assert store.size() == 0
+
+    def test_seed_record_roundtrip(self):
+        rec = make_seed_record("ab" * 20, "doc-xyz", SEED, ttl=60)
+        assert verify_seed_record(rec)
+
+    def test_seed_record_tamper_rejected(self):
+        rec = make_seed_record("ab" * 20, "doc-xyz", SEED, ttl=60)
+        # redirect the replication ask to a different doc
+        assert not verify_seed_record(dict(rec, doc="doc-evil"))
+        assert not verify_seed_record(dict(rec, key="cd" * 20))
+        assert not verify_seed_record(
+            dict(rec, sig=rec["sig"][:-4] + "AAA=")
+        )
+
+    def test_seed_record_ttl_expiry(self):
+        rec = make_seed_record("ab" * 20, "doc-xyz", SEED, ttl=5)
+        assert verify_seed_record(rec, now=rec["ts"] + 4)
+        assert not verify_seed_record(rec, now=rec["ts"] + 6)
 
 
 # ---------------------------------------------------------------------------
@@ -314,6 +337,126 @@ class TestDhtNode:
 
 
 # ---------------------------------------------------------------------------
+# announce signing cache + push seeding (O(1) steady-state gossip)
+
+
+class TestSignCache:
+    def _counting_sign(self, monkeypatch):
+        from hypermerge_tpu.utils import crypto
+
+        calls = []
+        real = crypto.sign
+        monkeypatch.setattr(
+            crypto, "sign",
+            lambda payload, seed: calls.append(1) or real(payload, seed),
+        )
+        return calls
+
+    def test_one_sign_per_half_ttl_window(self, monkeypatch):
+        """The steady-state refresher's signature bill: an unchanged
+        {key,host,port,ttl} re-announce inside the first half of the
+        TTL window reuses the cached record — exactly one Ed25519 sign
+        per window, the rest count dht.sign_cache_hits."""
+        from hypermerge_tpu import telemetry
+
+        calls = self._counting_sign(monkeypatch)
+        node = DhtNode()
+        try:
+            key = _id_hex(key_id("sign-cache-doc"))
+            before = telemetry.snapshot().get("dht.sign_cache_hits", 0)
+            node.announce(key, "127.0.0.1", 7001, ttl=60)
+            assert len(calls) == 1
+            node.announce(key, "127.0.0.1", 7001, ttl=60)
+            node.announce(key, "127.0.0.1", 7001, ttl=60)
+            assert len(calls) == 1
+            got = telemetry.snapshot()["dht.sign_cache_hits"] - before
+            assert got == 2
+            # a changed endpoint is a different record: re-sign
+            node.announce(key, "127.0.0.1", 7002, ttl=60)
+            assert len(calls) == 2
+        finally:
+            node.close()
+
+    def test_resigns_past_half_window(self, monkeypatch):
+        """The second half of the TTL window re-signs so the record
+        never expires out from under its refresher."""
+        calls = self._counting_sign(monkeypatch)
+        node = DhtNode()
+        try:
+            key = _id_hex(key_id("short-ttl-doc"))
+            node.announce(key, "127.0.0.1", 7003, ttl=0.12)
+            assert len(calls) == 1
+            time.sleep(0.08)  # past ttl/2
+            node.announce(key, "127.0.0.1", 7003, ttl=0.12)
+            assert len(calls) == 2
+        finally:
+            node.close()
+
+    def test_identity_change_invalidates_cache(self, monkeypatch):
+        """set_announce_seed drops cached records — they carry the old
+        key's signature and would verify against the wrong identity."""
+        calls = self._counting_sign(monkeypatch)
+        node = DhtNode()
+        try:
+            key = _id_hex(key_id("rekeyed-doc"))
+            node.announce(key, "127.0.0.1", 7004, ttl=60)
+            assert len(calls) == 1
+            node.set_announce_seed(os.urandom(32))
+            node.announce(key, "127.0.0.1", 7004, ttl=60)
+            assert len(calls) == 2
+        finally:
+            node.close()
+
+
+class TestPushSeed:
+    def test_seed_fires_hook_once_per_doc(self):
+        """announce(seed_doc=...) rides the same k-closest walk: every
+        receiver's hook fires exactly once per doc — a cached refresh
+        re-sends the record but the _seeded dedup never re-opens."""
+        from hypermerge_tpu import telemetry
+        from hypermerge_tpu.utils import keys as keymod
+
+        nodes = _mesh(4)
+        seen = []
+        try:
+            for n in nodes[1:]:
+                n.set_seed_hook(seen.append)
+            doc_id = keymod.create().public_key
+            key = _id_hex(key_id(keymod.discovery_id(doc_id)))
+            before = telemetry.snapshot().get("dht.seeds_rx", 0)
+            nodes[0].announce(key, "127.0.0.1", 7100, seed_doc=doc_id)
+            wait_until(lambda: len(seen) >= 3)
+            assert seen == [doc_id] * 3
+            assert telemetry.snapshot()["dht.seeds_rx"] - before >= 3
+            nodes[0].announce(key, "127.0.0.1", 7100, seed_doc=doc_id)
+            time.sleep(0.2)
+            assert len(seen) == 3  # dedup: a refresh never re-opens
+        finally:
+            for n in nodes:
+                n.close()
+
+    def test_key_mismatch_rejected(self):
+        """A valid signature is not enough: the record may only ask us
+        to replicate the doc whose keyspace position it is stored
+        under, or any announcer could push arbitrary docs onto the
+        fleet."""
+        from hypermerge_tpu.utils import keys as keymod
+
+        node = DhtNode()
+        seen = []
+        node.set_seed_hook(seen.append)
+        try:
+            doc_id = keymod.create().public_key
+            wrong_key = _id_hex(key_id("not-this-doc"))
+            rec = make_seed_record(wrong_key, doc_id, SEED, ttl=60)
+            assert not node._handle_seed(rec)
+            time.sleep(0.1)
+            assert seen == []
+        finally:
+            node.close()
+
+
+# ---------------------------------------------------------------------------
 # gossip sampler
 
 
@@ -518,6 +661,36 @@ class TestDhtSwarm:
             )
         finally:
             _teardown(repos, swarms, boot)
+
+
+class TestAnnounceAggregation:
+    def test_shared_via_is_one_announce_per_period(
+        self, fast_dht, monkeypatch
+    ):
+        """Two ids joined via the same doc key fold into ONE signed
+        announce record and one walk per period — O(docs), not
+        O(actor feeds) — and the per-feed keys never hit the DHT."""
+        from hypermerge_tpu import telemetry
+
+        # one announce window for the whole test: any extra passes the
+        # maintenance loop squeezes in must be provably skip-only
+        monkeypatch.setenv("HM_DHT_ANNOUNCE_S", "30")
+        boot = DhtNode()
+        sw = DhtSwarm(bootstrap=[boot.address])
+        try:
+            before = telemetry.snapshot().get("dht.announces", 0)
+            opts = JoinOptions(announce=True, lookup=False, via="doc-key")
+            sw.join("feed-one", opts)
+            sw.join("feed-two", opts)
+            sw.poke(timeout=5)
+            assert telemetry.snapshot()["dht.announces"] - before == 1
+            gkey = _id_hex(key_id("doc-key"))
+            assert sw.node.records.get(gkey)
+            assert not sw.node.records.get(_id_hex(key_id("feed-one")))
+            assert not sw.node.records.get(_id_hex(key_id("feed-two")))
+        finally:
+            sw.destroy()
+            boot.close()
 
 
 # ---------------------------------------------------------------------------

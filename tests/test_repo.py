@@ -260,3 +260,56 @@ def test_handle_fork_and_merge_conveniences():
         _t.sleep(0.02)
     assert h.value() == {"a": 1, "b": 2}
     repo.close()
+
+
+def test_actor_backfill_callbacks_out_of_order(repo):
+    """Replicated blocks whose per-block append callbacks arrive out of
+    order — or never — must still become visible. Feed.append_verified
+    fires its listeners OUTSIDE the feed lock, so two concurrent
+    backfill batches (multi-source repair after churn) can interleave
+    their _on_append fan-outs. Regression: the actor's slot list grew
+    exactly one slot per callback, so an out-of-order index raised
+    IndexError mid-fan-out and left the list short forever — seq_head
+    and changes_in_window clamped to the stale head and the doc never
+    converged (50-peer churn soak). The feed's block log is
+    authoritative; the slot list must re-size from it on every read."""
+    from hypermerge_tpu.crdt.change import Action, Change, Op, ROOT
+    from hypermerge_tpu.storage import block as blockmod
+
+    url = repo.create({"edits": []})
+    doc_id = validate_doc_url(url)
+    repo.change(url, lambda d: d["edits"].append(0))
+    actor = repo.back.actors[doc_id]
+    feed = actor.feed
+    head = actor.seq_head
+    max_op = max(
+        c.max_op for c in actor.changes_in_window(0, float("inf"))
+    )
+    blocks = [
+        blockmod.pack_change(
+            Change(
+                actor=doc_id,
+                seq=head + 1 + k,
+                start_op=max_op + 1 + k,
+                deps={},
+                ops=(
+                    Op(action=Action.SET, obj=ROOT, key=f"k{k}", value=k),
+                ),
+            ).to_json()
+        )
+        for k in range(3)
+    ]
+    # the batch lands in the block log first (as append_verified does
+    # under the feed lock); the per-block callbacks race in afterwards
+    with feed._lock:
+        for b in blocks:
+            feed._storage.append(b)
+    # callbacks arrive newest-first; the third never arrives at all
+    # (a concurrent fan-out died mid-batch)
+    actor._on_append(head + 1, blocks[1])
+    actor._on_append(head, blocks[0])
+    assert actor.seq_head == head + 3
+    window = actor.changes_in_window(head, float("inf"))
+    assert [c.seq for c in window] == [head + 1, head + 2, head + 3]
+    # the never-delivered block self-healed via the lazy feed decode
+    assert window[-1].ops[0].key == "k2"

@@ -29,6 +29,17 @@ outbound queue depth, and supervisor respawn count — the same split
 the merged payload mirrors into ``workers.<i>.*`` counters for the
 Prometheus dump.
 
+This ISSUE's async transport (``HM_NET_ASYNC=1``) folds into the
+``[net]`` group: ``net.aio.conns`` (live multiplexed-connection
+gauge), ``net.aio.loop_busy_ms`` (cumulative non-idle loop-thread
+time — its rate over wall time is the loop saturation ratio the
+1000-peer bench watches), frame/byte/ping rates, and
+``net.aio.sheds``. The O(1) steady-state gossip counters land next
+to them: ``net.cursor.full_tx`` vs ``net.cursor.delta_tx`` vs
+``net.cursor.suppressed`` (the delta-cursor win as a live ratio),
+plus ``dht.sign_cache_hits`` and ``dht.seeds_tx``/``dht.seeds_rx``
+(announce-signing amortization and push-seeding) in ``[dht]``.
+
 Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
 the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
 per-lock-class blocking-debt series whose ``live_engine`` row is the
