@@ -41,7 +41,10 @@ where the same keys are back-to-back wall times.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "configs": {...}}. Env: BENCH_DOCS (default 10240), BENCH_OPS (1024),
-BENCH_HOST_DOCS (8), BENCH_DIR (corpus location, default a fresh tmpdir).
+BENCH_HOST_DOCS (8), BENCH_DIR (corpus location, default a fresh tmpdir),
+BENCH_COLDOPEN_DOCS / BENCH_COLDOPEN_OPS / BENCH_COLDOPEN_WORKERS (the
+config_coldopen pack-plane gate: 10x-corpus cold open, serial vs pooled
+pack — see _config_coldopen).
 """
 
 import json
@@ -1436,6 +1439,86 @@ def _config6_demote_readopt(n_ops=4096, n_docs=3, rounds=3):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _config_coldopen(n_docs, n_ops):
+    """Pack-plane scaling gate (ISSUE 19): a cold open at ~10x the
+    primary corpus, once with the pack serialized (HM_PACK_WORKERS=1)
+    and once with the full pool (=4, BENCH_COLDOPEN_WORKERS), same disk
+    state. Reports the pool shape, per-worker busy lanes, the pool's
+    lane wall, and two derived gates:
+
+      coldopen_pack_speedup — sum(per-worker busy) / pack lane wall of
+        the pooled pass: the pool's REALIZED parallelism. The >=3x
+        target applies on a >=4-core host; a 1-2 core box reports its
+        honest (lower) number rather than asserting.
+      coldopen_pack_bound   — the pooled pack lane wall no longer
+        dominates: pack_wall <= max(io busy, dispatch busy), i.e. the
+        cold open is bounded by slab IO / device dispatch, not by the
+        host pack.
+
+    Scale with BENCH_COLDOPEN_DOCS (default 10x BENCH_DOCS) and
+    BENCH_COLDOPEN_OPS (default 256 — ops/doc shrinks so the 10x doc
+    axis, which is what shards across pack workers, carries the
+    scaling). The serialized pass's pack busy is also reported so
+    serial-vs-pool wall math stays possible downstream."""
+    from hypermerge_tpu.ops.corpus import make_corpus
+
+    co_docs = int(
+        os.environ.get("BENCH_COLDOPEN_DOCS", str(n_docs * 10))
+    )
+    co_ops = int(os.environ.get("BENCH_COLDOPEN_OPS", "256"))
+    workers = int(os.environ.get("BENCH_COLDOPEN_WORKERS", "4"))
+    co_tmp = tempfile.mkdtemp(prefix="hm_bench_co")
+
+    def _pass(n):
+        old = os.environ.get("HM_PACK_WORKERS")
+        os.environ["HM_PACK_WORKERS"] = str(n)
+        try:
+            return _open_and_materialize(co_tmp, urls)
+        finally:
+            if old is None:
+                os.environ.pop("HM_PACK_WORKERS", None)
+            else:
+                os.environ["HM_PACK_WORKERS"] = old
+
+    try:
+        urls = make_corpus(co_tmp, co_docs, co_ops, threads=16)
+        dt_serial, st_serial = _pass(1)
+        dt_pool, st_pool = _pass(workers)
+        if not st_pool.get("pipeline"):
+            return None  # serial twin: no pack plane to measure
+        lanes = [
+            float(b)
+            for b in (st_pool.get("t_pack_busy_per_worker") or [])
+        ]
+        pack_wall = float(st_pool.get("t_pack_wall", 0.0))
+        serial_busy = float(
+            st_serial.get("t_pack_busy", st_serial.get("t_pack", 0.0))
+        )
+        io_b = float(st_pool.get("t_io_busy", st_pool.get("t_io", 0.0)))
+        disp_b = float(
+            st_pool.get("t_dispatch_busy", st_pool.get("t_dispatch", 0.0))
+        )
+        return {
+            "config_coldopen_s": round(dt_pool, 2),
+            "config_coldopen_serial_s": round(dt_serial, 2),
+            "docs": co_docs,
+            "ops_per_doc": co_ops,
+            "cores": os.cpu_count() or 1,
+            "pack_workers": st_pool.get("pack_workers"),
+            "t_pack_busy_per_worker": lanes,
+            "t_pack_wall": round(pack_wall, 3),
+            "t_pack_serial_busy": round(serial_busy, 3),
+            "t_io_busy": round(io_b, 3),
+            "t_dispatch_busy": round(disp_b, 3),
+            "coldopen_pack_speedup": (
+                round(sum(lanes) / pack_wall, 2) if pack_wall > 0 else None
+            ),
+            "coldopen_pack_bound": bool(pack_wall <= max(io_b, disp_b)),
+        }
+    finally:
+        shutil.rmtree(co_tmp, ignore_errors=True)
+
+
 def _config_read(tmp, urls):
     """BASELINE round-15 serving config (ISSUE 11): N concurrent
     reader threads point-read the stored corpus through the
@@ -2055,6 +2138,25 @@ def main() -> None:
             f"path): {cfg3[0]:.2f}s -> {cfg3[1]:,.0f} ops/s",
             file=sys.stderr,
         )
+    cfgco = _soft(
+        "config_coldopen", lambda: _config_coldopen(n_docs, n_ops)
+    )
+    if cfgco is not None:
+        print(
+            f"# config_coldopen pack-plane gate "
+            f"({cfgco['docs']} docs x {cfgco['ops_per_doc']} ops, "
+            f"{cfgco['cores']} cores): pooled {cfgco['config_coldopen_s']}s "
+            f"(serial {cfgco['config_coldopen_serial_s']}s), "
+            f"{cfgco['pack_workers']} workers, lanes "
+            f"{cfgco['t_pack_busy_per_worker']} over "
+            f"{cfgco['t_pack_wall']}s wall -> "
+            f"{cfgco['coldopen_pack_speedup']}x pack speedup, "
+            f"pack_bound={cfgco['coldopen_pack_bound']} "
+            f"(io {cfgco['t_io_busy']}s, dispatch "
+            f"{cfgco['t_dispatch_busy']}s)",
+            file=sys.stderr,
+        )
+
     cfgrd = _soft("config_read", lambda: _config_read(tmp, urls))
     if cfgrd is not None:
         print(
@@ -2207,6 +2309,30 @@ def main() -> None:
                     ),
                     "config5_union_100k_ms": (
                         round(cfg5, 1) if cfg5 is not None else None
+                    ),
+                    # pack-plane scaling gate (ISSUE 19): 10x corpus,
+                    # serial vs pooled pack; the bool is the "cold
+                    # opens bounded by slab IO" regression gate
+                    "config_coldopen": cfgco,
+                    "config_coldopen_s": (
+                        cfgco["config_coldopen_s"]
+                        if cfgco is not None else None
+                    ),
+                    "pack_workers": (
+                        cfgco["pack_workers"]
+                        if cfgco is not None else None
+                    ),
+                    "t_pack_busy_per_worker": (
+                        cfgco["t_pack_busy_per_worker"]
+                        if cfgco is not None else None
+                    ),
+                    "coldopen_pack_speedup": (
+                        cfgco["coldopen_pack_speedup"]
+                        if cfgco is not None else None
+                    ),
+                    "coldopen_pack_bound": (
+                        cfgco["coldopen_pack_bound"]
+                        if cfgco is not None else None
                     ),
                     "config_read_qps": (
                         round(cfgrd[0]) if cfgrd is not None else None

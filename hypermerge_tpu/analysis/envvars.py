@@ -50,6 +50,13 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "stage queue."),
     EnvVar("HM_FETCH_WORKERS", "4", "Summary-fetch workers (sized to "
            "device count by the bulk loader)."),
+    EnvVar("HM_PACK_WORKERS", "0", "Pack-pool threads for the bulk "
+           "pipeline (slab-granular, order-preserving); 0 = auto: "
+           "min(4, cores) when the native pack is concurrency-safe, "
+           "else 1."),
+    EnvVar("HM_DEVICE_PACK", "0", "Run the fast-path pack as a jitted "
+           "device kernel (ops/pack_kernels.py); falls back native -> "
+           "numpy, bit-identical."),
     EnvVar("HM_LOAD_THREADS", "8", "Parallel sidecar prefetch threads "
            "for bulk document loads."),
     EnvVar("HM_FAST_OPEN", "1", "Serve single-doc opens from the "

@@ -370,6 +370,70 @@ GUARDS: Tuple[GuardedClass, ...] = (
             "spawns (traffic cannot precede them).",
     ),
     GuardedClass(
+        "SlabPipeline", "hypermerge_tpu.backend.pipeline",
+        "pipeline.pack_pool",
+        guarded=("_pack_turn", "_pack_eof_claimed"),
+        init_only=("docs", "prefetch", "classify", "pack", "dispatch",
+                   "fetch", "slab", "fetch_workers", "pack_workers",
+                   "pack_q", "disp_q", "fetch_q", "_q_gauges",
+                   "abort"),
+        unguarded=("total_slabs", "pack_busy", "pack_t0", "pack_t1",
+                   "memo_hits", "fallbacks"),
+        doc="The pack pool's ordered-emit state: the turn counter and "
+            "the EOF claim mutate under pipeline.pack_pool (N workers "
+            "race the pack queue, emit in slab order). `total_slabs` "
+            "is a write-once latch the io thread publishes BEFORE the "
+            "EOF token (the queue put/get is the happens-before edge "
+            "to the one reader, the EOF-claiming worker). "
+            "`pack_busy`/`pack_t0`/`pack_t1` are per-worker slots — "
+            "single-writer by construction (worker w owns index w) — "
+            "read only after the workers joined. "
+            "`memo_hits`/`fallbacks` are appended by the single io "
+            "thread and read after it joined.",
+    ),
+    GuardedClass(
+        "SlabPipeline(err)", "hypermerge_tpu.backend.pipeline",
+        "pipeline.err",
+        atomic_read_ok=("error", "error_stage"),
+        doc="First-error capture: _fail writes the winning (error, "
+            "stage) pair under pipeline.err; the driver reads them "
+            "lock-free after every stage joined.",
+    ),
+    GuardedClass(
+        "FeedColumnCache", "hypermerge_tpu.storage.colcache",
+        "store.colcache",
+        guarded=(
+            "_loaded", "_actors", "_keys", "_strings", "_floats",
+            "_bigints", "_pending_tables", "_base_planes",
+            "_base_meta", "_base_rows", "_row_chunks", "_pred_chunks",
+            "_n_rows_total", "_n_preds_total", "_commits_arr",
+            "_commits_new", "_cached",
+        ),
+        init_only=("_storage", "writer"),
+        doc="The pack path's shared-memo audit row (HM_PACK_WORKERS "
+            ">1): every interner table, chunk list, and the cached "
+            "FeedColumns snapshot mutate under the feed's rlock only. "
+            "Concurrent pack workers never reach these fields — "
+            "columns() hands them an immutable snapshot whose table "
+            "lists are COPIES taken under the lock.",
+    ),
+    GuardedClass(
+        "FeedColumns", "hypermerge_tpu.storage.colcache",
+        "store.colcache",
+        unguarded=("rows",),
+        doc="The shared snapshot pack workers read CONCURRENTLY. "
+            "`rows` is a lazy idempotent latch: ensure_rows() derives "
+            "the row matrix from the immutable planes and rebinds "
+            "once (GIL-atomic); racing workers at worst duplicate the "
+            "compute, never observe a torn value. The "
+            "`_prefix_single_ok` bool ops/columnar caches on the "
+            "object is the same idiom (set through a foreign "
+            "receiver, so only this story covers it — the checkers "
+            "cannot see it). Every other field is written by the "
+            "cache build under store.colcache before the object "
+            "escapes.",
+    ),
+    GuardedClass(
         "FileFeedStorage", "hypermerge_tpu.storage.feed",
         "store.feed_io",
         guarded=("_wfh", "_len_fh", "_fh_gen"),
@@ -449,6 +513,15 @@ REQUIRES: Dict[Tuple[str, str], str] = {
     ("RepoBackend", "_load_slabs_pipelined"): "repo.bulk",
     ("RepoBackend", "_memoize_summaries"): "repo.bulk",
     ("ResidencyCache", "_note_evicted"): "serve.cache",
+    ("FeedColumnCache", "_ensure_loaded"): "store.colcache",
+    ("FeedColumnCache", "_apply_tables"): "store.colcache",
+    ("FeedColumnCache", "_intern"): "store.colcache",
+    ("FeedColumnCache", "_take_pending"): "store.colcache",
+    ("FeedColumnCache", "_total_rows"): "store.colcache",
+    ("FeedColumnCache", "_total_preds"): "store.colcache",
+    ("FeedColumnCache", "_encode"): "store.colcache",
+    ("FeedColumnCache", "_encode_value"): "store.colcache",
+    ("FeedColumnCache", "_tables_blob"): "store.colcache",
     ("CursorStore", "_repo"): "store.cursors",
     ("CursorStore", "_absorb"): "store.cursors",
 }

@@ -276,6 +276,12 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "pipeline.err", None,
         "pipeline FetchContext._err_lock — first-error capture.",
     ),
+    LockClass(
+        "pipeline.pack_pool", None,
+        "SlabPipeline._pack_cv — the pack pool's ordered-emit turn "
+        "counter and EOF claim (HM_PACK_WORKERS workers race the pack "
+        "queue but emit into the dispatch queue in slab order).",
+    ),
     LockClass("front.repo", None, "RepoFrontend._lock."),
     LockClass("front.doc", None, "DocFrontend._lock."),
     LockClass(

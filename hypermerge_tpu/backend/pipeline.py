@@ -19,10 +19,20 @@ backlog.
                       per-doc feed specs, emitted as slab-sized entry
                       groups — composition IDENTICAL to the serial
                       loader's chunks, so summaries are bit-identical.
-    pack thread:      pack_docs_columns — the native hm_pack_prefix
-                      call is bound through ctypes.CDLL and therefore
-                      RELEASES the GIL (native/__init__.py), so packs
-                      genuinely overlap the io thread's reads.
+    pack pool:        pack_docs_columns on HM_PACK_WORKERS threads —
+                      the native hm_pack_prefix call is bound through
+                      ctypes.CDLL and therefore RELEASES the GIL
+                      (native/__init__.py pack_parallel_ok), so N
+                      workers pack N slabs on N cores concurrently.
+                      Sharding is slab-granular and the emit into the
+                      dispatch queue is SEQUENCED (a turn counter under
+                      the pipeline.pack_pool condition), so slab order
+                      and bytes stay identical to the single-worker
+                      and serial twins no matter which worker finishes
+                      first. Per-worker busy seconds are kept apart
+                      (pack_busy[w]) so busy-vs-wall accounting stays
+                      honest — the SUM of pack busy can exceed the
+                      load's wall once packs genuinely overlap.
     caller thread:    async device upload + dispatch (round-robin
                       across visible devices via parallel/sharded.py
                       SlabRoundRobin, mesh-sharded, or single-device)
@@ -50,7 +60,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
-from ..analysis.lockdep import make_lock
+from ..analysis.lockdep import make_condition, make_lock
 from .. import telemetry
 
 # process-wide pipeline series (telemetry registry): cumulative stage
@@ -100,6 +110,23 @@ def queue_depth() -> int:
     return max(1, int(os.environ.get("HM_PIPELINE_DEPTH", "2")))
 
 
+def pack_worker_count() -> int:
+    """Size of the pack pool. HM_PACK_WORKERS=N pins N workers; 0 (the
+    default) resolves automatically: min(4, cores) when the native pack
+    entry points both drop the GIL and are safe to call concurrently
+    (native.pack_parallel_ok — stateless C loops into caller-owned
+    buffers), else 1 — the numpy scatter twin holds the GIL for long
+    stretches, so extra pack threads would only contend."""
+    v = int(os.environ.get("HM_PACK_WORKERS", "0") or 0)
+    if v > 0:
+        return v
+    from .. import native
+
+    if not native.pack_parallel_ok():
+        return 1
+    return max(1, min(4, os.cpu_count() or 1))
+
+
 class FetchContext:
     """Handle on the async fetch stage (one or more workers — with >1
     device the fetch overlaps ACROSS chips: each worker can be pulling
@@ -129,7 +156,9 @@ class SlabPipeline:
       prefetch(doc_chunk)      read-ahead actors + sidecar columns
       classify(doc)            -> ("entry", e) | ("memo", (e, m))
                                   | ("fallback", doc)
-      pack(entries)            -> ColumnarBatch
+      pack(entries, seq)       -> ColumnarBatch (seq = slab index in
+                                  doc order — the device-pack path
+                                  uses it for per-chip placement)
       dispatch(entries, batch) -> pending summary entry (runs on the
                                   CALLER thread — device dispatch and
                                   doc init stay single-threaded)
@@ -143,11 +172,12 @@ class SlabPipeline:
         *,
         prefetch: Callable[[List[Any]], None],
         classify: Callable[[Any], Tuple[str, Any]],
-        pack: Callable[[List[Any]], Any],
+        pack: Callable[[List[Any], int], Any],
         dispatch: Callable[[List[Any], Any], Any],
         fetch: Callable[[Any], None],
         slab: int,
         fetch_workers: int = 1,
+        pack_workers: int = 1,
     ) -> None:
         self.docs = docs
         self.prefetch = prefetch
@@ -157,6 +187,7 @@ class SlabPipeline:
         self.fetch = fetch
         self.slab = max(1, int(slab))
         self.fetch_workers = max(1, int(fetch_workers))
+        self.pack_workers = max(1, int(pack_workers))
         depth = queue_depth()
         self.pack_q: "queue.Queue" = queue.Queue(maxsize=depth)
         self.disp_q: "queue.Queue" = queue.Queue(maxsize=depth)
@@ -175,6 +206,20 @@ class SlabPipeline:
         self._err_lock = make_lock("pipeline.err")
         self.memo_hits: List[Any] = []
         self.fallbacks: List[Any] = []
+        # -- pack pool sequencing + per-worker busy accounting ---------
+        # slabs are packed CONCURRENTLY but emitted into disp_q in slab
+        # order: a worker holding packed slab `seq` waits its turn on
+        # the pack_pool condition, so downstream (dispatch, fetch, doc
+        # init) sees the exact slab stream the serial twin produces.
+        self._pack_cv = make_condition("pipeline.pack_pool")
+        self._pack_turn = 0         # next slab seq allowed to emit
+        self._pack_eof_claimed = False  # one worker forwards _DONE
+        self.total_slabs: Optional[int] = None  # set by io before EOF
+        # per-worker slots, single-writer by construction (worker w is
+        # the only writer of index w) — read after the workers join
+        self.pack_busy = [0.0] * self.pack_workers
+        self.pack_t0 = [None] * self.pack_workers  # first pack start
+        self.pack_t1 = [None] * self.pack_workers  # last pack end
 
     # -- queue plumbing (abort-aware: a failed stage must never leave a
     # sibling blocked forever on a full/empty bounded queue) ----------
@@ -216,6 +261,7 @@ class SlabPipeline:
         pipeline and serial materialize bit-identical slabs."""
         try:
             buf: List[Any] = []
+            seq = 0
             for base in range(0, len(self.docs), self.slab):
                 if self.abort.is_set():
                     raise _Abort()
@@ -235,29 +281,84 @@ class SlabPipeline:
                 # the put blocks on a full queue: that's backpressure
                 # WAIT, not io busy — keep it outside the busy window
                 while len(buf) >= self.slab:
-                    self._put(self.pack_q, buf[: self.slab])
+                    self._put(self.pack_q, (seq, buf[: self.slab]))
+                    seq += 1
                     buf = buf[self.slab :]
             if buf:
-                self._put(self.pack_q, buf)
+                self._put(self.pack_q, (seq, buf))
+                seq += 1
+            # publish the slab count BEFORE the EOF token: the worker
+            # that claims EOF forwarding reads it after taking the
+            # token off the queue (queue put/get is the happens-before)
+            self.total_slabs = seq
             self._put(self.pack_q, _DONE)
         except _Abort:
             pass
         except BaseException as e:
             self._fail("io", e)
 
-    def _pack_loop(self) -> None:
+    def _await_pack_turn(self, seq: int) -> None:
+        """Block until slab `seq` may emit into disp_q (ordered merge
+        of the pack pool's out-of-order completions). Abort-aware."""
+        with self._pack_cv:
+            while self._pack_turn != seq:
+                if self.abort.is_set():
+                    raise _Abort()
+                self._pack_cv.wait(_POLL_S)
+
+    def _bump_pack_turn(self) -> None:
+        with self._pack_cv:
+            self._pack_turn += 1
+            self._pack_cv.notify_all()
+
+    def pack_wall(self) -> float:
+        """Pack LANE span: first pack start -> last pack end across the
+        pool. This is the wall-clock footprint of the pack stage; with
+        N workers the busy SUM (sum(pack_busy)) exceeds it once packs
+        genuinely overlap, and busy/wall is the measured parallel
+        speedup. Read after the workers joined."""
+        t0s = [t for t in self.pack_t0 if t is not None]
+        t1s = [t for t in self.pack_t1 if t is not None]
+        if not t0s or not t1s:
+            return 0.0
+        return max(0.0, max(t1s) - min(t0s))
+
+    def _pack_loop(self, widx: int) -> None:
+        """One pack-pool worker. Workers race through pack_q (slab
+        compute overlaps across cores — hm_pack_prefix drops the GIL)
+        but emit strictly in slab order via the turn counter, so the
+        dispatch stream is byte-identical to a single pack thread. The
+        EOF token recirculates to drain siblings; exactly one worker
+        claims it and forwards _DONE only after every real slab
+        emitted."""
         try:
             while True:
                 item = self._get(self.pack_q)
                 if item is _DONE:
+                    # siblings need the token too
+                    self._put(self.pack_q, _DONE)
+                    with self._pack_cv:
+                        if self._pack_eof_claimed:
+                            return
+                        self._pack_eof_claimed = True
+                    self._await_pack_turn(self.total_slabs)
                     self._put(self.disp_q, _DONE)
                     return
+                seq, entries = item
                 t0 = time.perf_counter()
                 with telemetry.span("pipeline.pack", "pipeline"):
-                    packed = self.pack(item)
-                _M_BUSY["pack"].add(time.perf_counter() - t0)
+                    packed = self.pack(entries, seq)
+                t1 = time.perf_counter()
+                self.pack_busy[widx] += t1 - t0
+                if self.pack_t0[widx] is None:
+                    self.pack_t0[widx] = t0
+                self.pack_t1[widx] = t1
+                _M_BUSY["pack"].add(t1 - t0)
                 _M_SLABS.add(1)
-                self._put(self.disp_q, (item, packed))
+                # ordered emit: the turn-wait is backpressure, not busy
+                self._await_pack_turn(seq)
+                self._put(self.disp_q, (entries, packed))
+                self._bump_pack_turn()
         except _Abort:
             pass
         except BaseException as e:
@@ -292,9 +393,15 @@ class SlabPipeline:
         io_t = threading.Thread(
             target=self._io_loop, name="hm-pipe-io", daemon=True
         )
-        pack_t = threading.Thread(
-            target=self._pack_loop, name="hm-pipe-pack", daemon=True
-        )
+        pack_ts = [
+            threading.Thread(
+                target=self._pack_loop,
+                args=(i,),
+                name=f"hm-pipe-pack-{i}",
+                daemon=True,
+            )
+            for i in range(self.pack_workers)
+        ]
         fetch_ts = [
             threading.Thread(
                 target=self._fetch_loop,
@@ -306,7 +413,8 @@ class SlabPipeline:
         ]
         ctx.threads = fetch_ts
         io_t.start()
-        pack_t.start()
+        for t in pack_ts:
+            t.start()
         for t in fetch_ts:
             t.start()
         try:
@@ -327,7 +435,8 @@ class SlabPipeline:
             self._fail("dispatch", e)
         # upstream stages are done (or aborting): join them bounded
         io_t.join(_JOIN_S)
-        pack_t.join(_JOIN_S)
+        for t in pack_ts:
+            t.join(_JOIN_S)
         if self.error is not None:
             # drain so nothing pins batches/device refs, then take the
             # fetch workers down too — the load failed as a unit
@@ -341,7 +450,7 @@ class SlabPipeline:
                         break
             if (
                 io_t.is_alive()
-                or pack_t.is_alive()
+                or any(t.is_alive() for t in pack_ts)
                 or any(t.is_alive() for t in fetch_ts)
             ):
                 raise PipelineError(  # pragma: no cover - defensive
@@ -351,7 +460,7 @@ class SlabPipeline:
             raise PipelineError(
                 f"bulk load pipeline stage '{self.error_stage}' failed"
             ) from self.error
-        if io_t.is_alive() or pack_t.is_alive():
+        if io_t.is_alive() or any(t.is_alive() for t in pack_ts):
             raise PipelineError(  # pragma: no cover - defensive
                 "pipeline workers did not drain"
             )

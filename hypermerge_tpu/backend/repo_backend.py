@@ -822,6 +822,7 @@ class RepoBackend:
                 "memo": 0,
                 "fallback": 0,
                 "pipeline": 1 if pipelined else 0,
+                "pack_workers": 0,  # serial twin: pack inline, no pool
                 "t_sql": round(now() - t0, 3),
                 "t_io": 0.0,
                 "t_spec": 0.0,
@@ -997,7 +998,11 @@ class RepoBackend:
         post-memo-filter entry stream, in doc order), so both paths
         produce bit-identical summaries."""
         from ..ops.columnar import round_up_pow2
-        from .pipeline import FetchContext, SlabPipeline
+        from .pipeline import (
+            FetchContext,
+            SlabPipeline,
+            pack_worker_count,
+        )
 
         now = time.perf_counter
         contiguous: Dict[str, bool] = {}
@@ -1029,12 +1034,22 @@ class RepoBackend:
             finally:
                 self._stat_add("t_spec", now() - t0)
 
-        def pack(chunk):
+        def pack(chunk, seq):
+            # rr / rr_cursor0 bind below, before the pipeline runs.
+            # The device hint places a device pack (HM_DEVICE_PACK=1)
+            # on the chip strict round-robin will dispatch slab `seq`
+            # to, so the packed columns never cross chips; host packs
+            # ignore it. Runs on a pack-pool worker (HM_PACK_WORKERS).
             t0 = now()
             batch = pack_docs_columns(
                 [e[1] for e in chunk],
                 n_docs=pad_docs or round_up_pow2(len(chunk)),
                 n_rows=pad_rows,
+                device=(
+                    rr.pack_device_for(seq, rr_cursor0)
+                    if rr is not None
+                    else None
+                ),
             )
             self._stat_add("t_pack", now() - t0)
             return batch
@@ -1055,6 +1070,10 @@ class RepoBackend:
         rr = self._slab_rr()
         disp0 = list(rr.t_dispatch_chip) if rr is not None else None
         slabs0 = list(rr.slabs_per_chip) if rr is not None else None
+        # round-robin cursor snapshot: with strict round-robin the chip
+        # for slab seq is fully determined by (cursor at load start +
+        # seq), so pack workers can place device packs ahead of dispatch
+        rr_cursor0 = rr.cursor() if rr is not None else 0
 
         def fetch(entry):
             t0 = now()
@@ -1097,6 +1116,7 @@ class RepoBackend:
             fetch=fetch,
             slab=slab,
             fetch_workers=workers,
+            pack_workers=pack_worker_count(),
         )
         ctx = FetchContext()
         try:
@@ -1105,6 +1125,15 @@ class RepoBackend:
             if self._rr_value is not None:
                 # dispatching done: drop backpressure refs
                 self._rr_value.release()
+        with self._stats_lock:
+            # pool shape + per-worker busy lanes: sum(busy) can exceed
+            # the wall once packs overlap — profile_cold draws one lane
+            # per worker and bench computes speedup = sum(busy)/wall
+            stats["pack_workers"] = pipe.pack_workers
+            stats["t_pack_busy_per_worker"] = [
+                round(b, 6) for b in pipe.pack_busy
+            ]
+            stats["t_pack_wall"] = round(pipe.pack_wall(), 6)
         if rr is not None:
             with self._stats_lock:
                 stats["t_dispatch_chips"] = [

@@ -190,6 +190,23 @@ def pack_drops_gil() -> bool:
     return lib is not None and not isinstance(lib, ctypes.PyDLL)
 
 
+def pack_parallel_ok() -> bool:
+    """True when hm_pack_prefix / hm_pack_value_minmax may be called
+    from SEVERAL threads at once — the pack pool's contract
+    (HM_PACK_WORKERS > 1, backend/pipeline.py).
+
+    The entry points are stateless C loops: every pointer they touch
+    (source planes, LUTs, output buffers) is a caller-owned argument,
+    there are no globals, no allocation, and no errno-style side
+    channels, so concurrent calls with DISTINCT output buffers are
+    safe by construction. Distinctness is the caller's obligation and
+    holds trivially for the pool: each worker packs a different slab
+    into buffers it just allocated. Combined with the GIL release
+    (pack_drops_gil) this is what makes N pack workers N-core real
+    rather than time-sliced."""
+    return pack_drops_gil()
+
+
 def codec_lib() -> Optional[ctypes.CDLL]:
     """The library handle iff it carries the change-frame codec entry
     points (crdt/codec.py native fast path); None otherwise."""

@@ -375,7 +375,13 @@ def _prefix_single_ok(fc) -> bool:
     """True if a feed qualifies for the no-sort prefix pack: every op's
     container/element/pred references stay inside the feed (single-writer
     history), and ctr is strictly increasing (commit order == causal
-    order). Cached on the FeedColumns object."""
+    order). Cached on the FeedColumns object.
+
+    The cache is an idempotent latch, safe under concurrent pack
+    workers (HM_PACK_WORKERS>1, guard manifest entry for FeedColumns):
+    racing callers compute the same bool from immutable planes and the
+    attribute rebind is GIL-atomic, so the worst case is duplicate
+    compute, never a torn or wrong value."""
     ok = getattr(fc, "_prefix_single_ok", None)
     if ok is None:
         n = fc.n_rows
@@ -412,15 +418,22 @@ _pack_src_idx_cache: Optional[np.ndarray] = None
 
 def _pack_src_idx() -> np.ndarray:
     """Indices of the native pack's source planes within the sidecar's
-    PLANE_NAMES order (what FeedColumns.plane_meta offsets follow)."""
+    PLANE_NAMES order (what FeedColumns.plane_meta offsets follow).
+
+    Thread-safety (pack pool, HM_PACK_WORKERS>1): compute-local, then
+    ONE assignment publishes — concurrent first callers may each build
+    the (identical, immutable) array, but no caller can ever observe a
+    half-built cache; the module-global rebind is GIL-atomic."""
     global _pack_src_idx_cache
-    if _pack_src_idx_cache is None:
+    got = _pack_src_idx_cache
+    if got is None:
         from ..storage.colcache import PLANE_NAMES
 
-        _pack_src_idx_cache = np.asarray(
+        got = np.asarray(
             [PLANE_NAMES.index(n) for n in _PACK_SRC_PLANES], np.int64
         )
-    return _pack_src_idx_cache
+        _pack_src_idx_cache = got
+    return got
 
 
 def _native_pack_lib():
@@ -539,7 +552,7 @@ def _native_pack_prefix(
 
 
 def _try_pack_prefix_single(
-    doc_specs, n_rows, n_pred, n_docs
+    doc_specs, n_rows, n_pred, n_docs, device=None
 ) -> Optional[ColumnarBatch]:
     """Fast pack for the dominant cold-open shape: one single-writer feed
     per doc, whole-prefix windows. Rows are already in causal order (ctr
@@ -549,12 +562,15 @@ def _try_pack_prefix_single(
     M-sized argsorts and composite-key resolution collapse into one
     searchsorted over an already-sorted key.
 
-    The padded-plane emit itself has two bit-identical twins: the C++
-    batch entry point (native/src/hm_native.cpp hm_pack_prefix — one
-    fused pass per column straight from the feeds' narrow planes into
-    preallocated output buffers) and the numpy scatter below (the
+    The padded-plane emit itself has three bit-identical twins, tried
+    in order: the jitted device kernel (ops/pack_kernels.py, only when
+    HM_DEVICE_PACK=1 — host work collapses to narrow-plane concats),
+    the C++ batch entry point (native/src/hm_native.cpp hm_pack_prefix
+    — one fused pass per column straight from the feeds' narrow planes
+    into preallocated output buffers), and the numpy scatter below (the
     fallback when the native layer is absent, HM_NATIVE_PACK=0, or a
-    feed is not plane-backed)."""
+    feed is not plane-backed). `device` is the mesh scheduler's
+    placement hint for the device twin; host twins ignore it."""
     for spec in doc_specs:
         if len(spec) != 1:
             return None
@@ -704,7 +720,17 @@ def _try_pack_prefix_single(
     native_lib = _native_pack_lib() if use_planes else None
     cols: Dict[str, np.ndarray] = {}
 
-    if native_lib is not None:
+    from .pack_kernels import device_pack_enabled
+
+    if device_pack_enabled():
+        from .pack_kernels import device_pack_prefix
+
+        cols = device_pack_prefix(
+            fcs, fc_idx, fc_idx_a, ends, writer_g, flat_lut,
+            D, Dp, N, i16ok, row_dt, kdt, device,
+        )
+
+    if not cols and native_lib is not None:
         cols = _native_pack_prefix(
             native_lib, fcs, fc_idx_a, ends, writer_g, flat_lut,
             D, Dp, N, i16ok, row_dt, kdt,
@@ -826,6 +852,7 @@ def pack_docs_columns(
     n_rows: Optional[int] = None,
     n_pred: Optional[int] = None,
     n_docs: Optional[int] = None,
+    device: Optional[Any] = None,
 ) -> ColumnarBatch:
     """Pack documents from columnar feed windows.
 
@@ -841,9 +868,14 @@ def pack_docs_columns(
 
     Single-writer whole-prefix loads (the dominant cold-open shape)
     dispatch to a no-sort fast path; anything else takes the general
-    sorted-composite path below.
+    sorted-composite path below. `device` is a placement hint for the
+    fast path's device pack kernel (HM_DEVICE_PACK=1): the chip the
+    mesh scheduler will dispatch this slab to. Host packs — and the
+    general path, which never runs on device — ignore it.
     """
-    fast = _try_pack_prefix_single(doc_specs, n_rows, n_pred, n_docs)
+    fast = _try_pack_prefix_single(
+        doc_specs, n_rows, n_pred, n_docs, device
+    )
     if fast is not None:
         return fast
     from ..storage.colcache import (

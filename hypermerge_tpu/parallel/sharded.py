@@ -610,6 +610,26 @@ class SlabRoundRobin:
         except ValueError:
             return None
 
+    def cursor(self) -> int:
+        """Round-robin cursor snapshot. The bulk loader reads it on the
+        caller thread BEFORE the pipeline starts; combined with
+        pack_device_for it lets pack workers predict placement ahead of
+        dispatch."""
+        return self._next
+
+    def pack_device_for(self, seq: int, cursor0: int):
+        """Device slab `seq` of a load will be dispatched to, given the
+        cursor snapshot `cursor0` taken when the load started. Valid
+        because strict round-robin consumes slabs in seq order straight
+        off the cursor — the device-pack path (HM_DEVICE_PACK=1) uses
+        it to build the packed columns ON the chip that will run the
+        materialize kernel, so no cross-chip copy rides the dispatch.
+        Least-loaded placement is load-dependent, so no prediction is
+        possible: returns None (pack uses the default device)."""
+        if self.least_loaded:
+            return None
+        return self.devices[(cursor0 + seq) % len(self.devices)]
+
     def _pick_device(self) -> int:
         """Next device index. Round-robin: the cursor, regardless of
         load (the dispatch below blocks if it is saturated). Least

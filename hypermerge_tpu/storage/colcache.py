@@ -1078,7 +1078,10 @@ class FeedColumnCache:
         base = self._total_rows()
         out_rows: List[List[int]] = []
         out_preds: List[Tuple[int, int, int]] = []
-        aid = lambda actor: self._intern("a", self._actors, actor)  # noqa: E731
+        # hoisted out of the closure: the guarded-attr rule checks the
+        # _actors read at THIS (REQUIRES-covered) function depth
+        actors = self._actors
+        aid = lambda actor: self._intern("a", actors, actor)  # noqa: E731
         for i, op in enumerate(change.ops):
             ctr = change.start_op + i
             if op.obj == ROOT:

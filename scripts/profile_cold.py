@@ -71,6 +71,28 @@ def _stage_timeline(stats, wall):
         f"{busy_total:.3f}s -> {busy_total / max(cp, 1e-9):.2f}x "
         "concurrency"
     )
+    _pack_lanes(stats)
+
+
+def _pack_lanes(stats):
+    """Per-worker pack lanes (HM_PACK_WORKERS > 1): each worker's busy
+    seconds against the pool's lane wall (first pack start -> last pack
+    end). With real overlap sum(busy) exceeds the wall — the ratio is
+    the pool's parallel speedup. A single worker (or the serial twin)
+    has nothing to show."""
+    lanes = stats.get("t_pack_busy_per_worker") or []
+    if len(lanes) < 2:
+        return
+    pack_wall = stats.get("t_pack_wall", 0.0)
+    print(f"pack pool [{len(lanes)} workers, lane wall {pack_wall:.3f}s]:")
+    for w, b in enumerate(lanes):
+        bar = "#" * max(1, int(40 * b / max(pack_wall, 1e-9)))
+        print(f"  worker {w:<6} {b:7.3f}s |{bar}")
+    busy = sum(lanes)
+    print(
+        f"  pack busy total {busy:.3f}s -> "
+        f"{busy / max(pack_wall, 1e-9):.2f}x pack speedup"
+    )
 
 
 if "--cprofile" in sys.argv:

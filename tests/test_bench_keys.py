@@ -1,0 +1,92 @@
+"""Bench JSON schema is additive-only.
+
+The driver regression-gates on bench.py's single JSON line; a renamed
+or dropped key silently breaks the trajectory comparison. This pins
+every key any prior round shipped (plus this round's pack-plane keys)
+as present in the source — new keys may be added freely, existing ones
+may never be removed or renamed."""
+
+from pathlib import Path
+
+BENCH_SRC = Path(__file__).parent.parent / "bench.py"
+
+# every configs{} key shipped by a prior BASELINE round, plus the
+# top-level envelope; frozen — additions only
+PINNED_KEYS = (
+    # envelope
+    "metric",
+    "value",
+    "unit",
+    "vs_baseline",
+    "configs",
+    "telemetry",
+    # primary + stage breakdown
+    "cold_open_s_10k_docs",
+    "cold_first_process_s",
+    "docs",
+    "ops_per_doc",
+    "stages",
+    "host_serial_s",
+    "device_s",
+    "pipeline",
+    "wall_critical_path_s",
+    "multichip_8_s",
+    "multichip_mode",
+    "multichip_devices",
+    "multichip_topology",
+    "multichip_stages",
+    "projection_8chip_reference_s",
+    # aux configs
+    "config1_change_latency_us",
+    "config2_convergence_s",
+    "config2_edits_per_s",
+    "config2_live",
+    "config_churn_s",
+    "config_churn_edits_per_s",
+    "config_churn",
+    "config_swarm_s",
+    "config_swarm",
+    "config_fleet1000_s",
+    "config_fleet1000",
+    "config_crash_t_recover_ms",
+    "config_crash",
+    "config6_live_first_edit_ms",
+    "config6_live_burst_edits_per_s",
+    "config6_live",
+    "config6_live_adopt_decode_ms",
+    "config6_demote_readopt_ms",
+    "config6_demote",
+    "lock_held_blocking_ms",
+    "config_writers_edits_per_s",
+    "config_writers_scaling",
+    "config_writers_scaling_8_32",
+    "config_writers_hotdoc_edits_per_s",
+    "config_writers_hotdoc_converged",
+    "config3_multiactor_ops_per_s",
+    "config5_union_100k_ms",
+    "config_read_qps",
+    "config_read_p50_ms",
+    "config_read_p99_ms",
+    "config_read_host_qps",
+    "config_read_speedup",
+    "config_read",
+    "config6_text_trace_ops_per_s",
+    "device_link_rtt_ms",
+    # pack-plane gate (ISSUE 19)
+    "config_coldopen",
+    "config_coldopen_s",
+    "pack_workers",
+    "t_pack_busy_per_worker",
+    "coldopen_pack_speedup",
+    "coldopen_pack_bound",
+)
+
+
+def test_bench_json_keys_additive_only():
+    src = BENCH_SRC.read_text()
+    missing = [k for k in PINNED_KEYS if f'"{k}"' not in src]
+    assert not missing, (
+        f"bench.py no longer emits pinned JSON keys {missing}: the "
+        "bench schema is additive-only — restore the keys (aliases are "
+        "fine) instead of renaming/removing"
+    )

@@ -387,6 +387,93 @@ def test_pipeline_per_chip_stats(tmp_path, monkeypatch):
     repo.close()
 
 
+def _load_once(path, ids, monkeypatch, slab, workers, device_pack, order):
+    """One pipelined bulk load under a given pack-plane config; env vars
+    are set in the given order (the routing must not care)."""
+    monkeypatch.setenv("HM_PIPELINE", "1")
+    monkeypatch.setenv("HM_DEVICE_MIN_CELLS", "1")
+    pair = (("HM_PACK_WORKERS", workers), ("HM_DEVICE_PACK", device_pack))
+    for var, val in pair if order == 0 else pair[::-1]:
+        monkeypatch.setenv(var, val)
+    repo = Repo(path=str(path))
+    back = repo.back
+    back.load_documents_bulk(ids, slab=slab)
+    stats = dict(back.last_bulk_stats)
+    summ = back.fetch_bulk_summaries()
+    out = {d: _doc_summary_bytes(summ, d) for d in summ.doc_ids}
+    repo.close()
+    _assert_pipe_threads_drained()
+    return out, stats
+
+
+def test_pipeline_pack_worker_matrix(tmp_path, monkeypatch):
+    """HM_PACK_WORKERS={0,1,4} x HM_DEVICE_PACK={0,1}, both env set
+    orders, over a ragged-tail corpus (10 docs / slab 4 -> 4+4+2):
+    every pack-plane config produces summaries byte-identical to the
+    one-worker host baseline, and the pool reports its shape
+    (pack_workers, per-worker busy lanes, lane wall)."""
+    from hypermerge_tpu.backend.pipeline import pack_worker_count
+    from hypermerge_tpu.ops import pack_kernels
+
+    src = tmp_path / "src"
+    urls, _want = _make_corpus(src, n_docs=10, seed=19)
+    ids = [validate_doc_url(u) for u in urls]
+
+    results = {}
+    matrix = [
+        ("1", "0"), ("0", "0"), ("4", "0"),
+        ("1", "1"), ("4", "1"), ("0", "1"),
+    ]
+    for i, (workers, device) in enumerate(matrix):
+        copy = tmp_path / f"m{i}"
+        shutil.copytree(src, copy)
+        packs0 = pack_kernels._M_PACKS.value()
+        out, stats = _load_once(
+            copy, ids, monkeypatch, 4, workers, device, order=i % 2
+        )
+        assert stats["pipeline"] == 1
+        want_pool = pack_worker_count()  # env still set from _load_once
+        assert stats["pack_workers"] == want_pool
+        if workers != "0":
+            assert stats["pack_workers"] == int(workers)
+        assert len(stats["t_pack_busy_per_worker"]) == want_pool
+        assert stats["t_pack_wall"] >= 0.0
+        assert sum(stats["t_pack_busy_per_worker"]) >= 0.0
+        if device == "1":
+            # the device kernel actually packed (it never silently
+            # falls through on these clean single-writer slabs)
+            assert pack_kernels._M_PACKS.value() > packs0
+        results[(workers, device)] = out
+    base = results[("1", "0")]
+    for cfg, out in results.items():
+        assert set(out) == set(base), cfg
+        for d in base:
+            assert out[d] == base[d], (cfg, d)
+
+
+@pytest.mark.slow
+def test_pipeline_pack_pool_large_shape(tmp_path, monkeypatch):
+    """Largest-shape tier: a wider corpus across many slabs with the
+    full pool (4 workers) and the device kernel — still byte-identical
+    to the serial twin, pool accounting intact."""
+    src = tmp_path / "src"
+    urls, _want = _make_corpus(src, n_docs=42, seed=23)
+    ids = [validate_doc_url(u) for u in urls]
+
+    copy0 = tmp_path / "serial"
+    shutil.copytree(src, copy0)
+    base, _ = _load_once(copy0, ids, monkeypatch, 8, "1", "0", order=0)
+
+    copy1 = tmp_path / "pool"
+    shutil.copytree(src, copy1)
+    out, stats = _load_once(copy1, ids, monkeypatch, 8, "4", "1", order=1)
+    assert stats["pack_workers"] == 4
+    assert len(stats["t_pack_busy_per_worker"]) == 4
+    assert set(out) == set(base) and len(out) == 42
+    for d in base:
+        assert out[d] == base[d], d
+
+
 def test_pipeline_stats_report_busy_and_critical_path(tmp_path, monkeypatch):
     """Pipeline mode reports per-stage busy time (t_*_busy) and the
     overlapped wall critical path alongside the canonical keys."""
