@@ -142,8 +142,40 @@ REGISTRY: Tuple[EnvVar, ...] = (
            "batcher: concurrent reads inside it coalesce into one "
            "batched kernel dispatch."),
     EnvVar("HM_SERVE_QUEUE", "4096", "Bound of the read admission "
-           "queue; overflowing reads degrade to the host path "
-           "(serve.fallbacks) instead of queueing unboundedly."),
+           "queue; overflow is a dedicated service-plane signal "
+           "(serve.overload_shed) answered via the host path or a "
+           "typed refusal, never an unbounded queue."),
+    # -- service plane (overload control) -------------------------------
+    EnvVar("HM_SERVICE", "1", "Overload controller (serve/overload.py "
+           "brownout ladder): signal-driven admission control at the "
+           "read front door plus WAL ack pacing (0 = no controller)."),
+    EnvVar("HM_SERVICE_TICK_MS", "50", "Period of the controller's "
+           "signal-sampling tick."),
+    EnvVar("HM_SERVICE_P99_SLO_MS", "50", "Serve-read p99 SLO the "
+           "pressure signal normalizes against (pressure 1.0 = p99 "
+           "at SLO)."),
+    EnvVar("HM_SERVICE_RETRY_AFTER_MS", "100", "Floor of the "
+           "retry-after a typed Overload refusal carries."),
+    EnvVar("HM_SERVICE_ACK_STRETCH_MS", "25", "Extra group-commit "
+           "gather window while SHED — durable-write backpressure "
+           "(acks pace down; nothing acked is dropped)."),
+    EnvVar("HM_SERVICE_FORCE", None, "Pin the ladder state "
+           "(healthy|brownout|shed) — deterministic tests and drills; "
+           "unset = signal-driven."),
+    EnvVar("HM_BROWNOUT_HI", "1.0", "Pressure watermark at/above "
+           "which consecutive ticks escalate the ladder one rung."),
+    EnvVar("HM_BROWNOUT_LO", "0.5", "Pressure watermark at/below "
+           "which consecutive ticks de-escalate one rung (the dead "
+           "band between LO and HI holds the rung: no flapping)."),
+    EnvVar("HM_BROWNOUT_UP_TICKS", "3", "Consecutive over-HI ticks "
+           "required to escalate."),
+    EnvVar("HM_BROWNOUT_DOWN_TICKS", "10", "Consecutive under-LO "
+           "ticks required to de-escalate (slower down than up: "
+           "recovery must be proven, not hoped)."),
+    EnvVar("HM_QUOTA_READS_S", "512", "Per-tenant token-bucket refill "
+           "rate enforced at the front door while SHED (reads/s)."),
+    EnvVar("HM_QUOTA_BURST", "64", "Per-tenant token-bucket burst "
+           "capacity."),
     # -- write plane (hub daemon) ---------------------------------------
     EnvVar("HM_NATIVE_CODEC", "1", "Binary change frames (native "
            "GIL-free encode when built, bit-identical Python twin "

@@ -194,6 +194,25 @@ GUARDS: Tuple[GuardedClass, ...] = (
             "monitoring snapshot read.",
     ),
     GuardedClass(
+        "OverloadController", "hypermerge_tpu.serve.overload",
+        "serve.overload",
+        guarded=("_tenants", "_last", "_pressure", "_thread",
+                 "_closed"),
+        atomic_read_ok=("_state",),
+        init_only=("_signals", "_now", "_slo_s", "_tick_s", "_retry_s",
+                   "_stretch_s", "_rate", "_burst", "_ladder", "_force",
+                   "_m"),
+        doc="The service plane's shared state: the tenant "
+            "token-bucket table, the last signal sample, and the "
+            "ticker lifecycle mutate under serve.overload (tick, "
+            "admit_read, report). `_state` — the one question every "
+            "hot path asks (am I shedding?) — is written under the "
+            "lock by tick() and read as a GIL-atomic int snapshot by "
+            "admit_read/defer_install/ack_extra_s. `_ladder` is a "
+            "construction-time reference whose internals mutate only "
+            "inside tick()'s critical section.",
+    ),
+    GuardedClass(
         "ResidencyCache", "hypermerge_tpu.serve.resident", "serve.cache",
         guarded=("_entries", "_evicted", "_use"),
         atomic_read_ok=("_bytes",),
@@ -341,9 +360,13 @@ GUARDS: Tuple[GuardedClass, ...] = (
         "net.gossip",
         guarded=("_samples",),
         init_only=("fanout", "reshuffle_s", "_rng"),
+        unguarded=("overload_ctl",),
         doc="The per-key sample table mutates under net.gossip; the "
             "hot broadcast paths hold it for dict bookkeeping only. "
-            "`_rng` is only ever driven under the lock.",
+            "`_rng` is only ever driven under the lock. "
+            "`overload_ctl` is a set-once service-plane hook "
+            "installed by Network wiring before traffic flows; the "
+            "sample path snapshots the reference (GIL-atomic).",
     ),
     GuardedClass(
         "_FrontendHub", "hypermerge_tpu.net.ipc", "net.ipc.hub",
@@ -481,12 +504,16 @@ GUARDS: Tuple[GuardedClass, ...] = (
         ),
         init_only=("path", "session", "tier", "_max_bytes",
                    "_window_s"),
+        unguarded=("ack_pacer",),
         doc="The shared journal: file handle (rebound at checkpoint "
             "rotation), append end offset, the group-commit "
             "synced/syncing handshake, the session dirty-name ledger "
             "and the checkpoint-pending storage set all mutate under "
             "store.wal. The commit fsync snapshots the handle under "
-            "the lock and syncs OUTSIDE it.",
+            "the lock and syncs OUTSIDE it. `ack_pacer` is a "
+            "set-once service-plane hook installed at backend wiring "
+            "before any writer exists; the commit leader snapshots "
+            "the reference once per window (GIL-atomic).",
     ),
 )
 
@@ -524,6 +551,7 @@ REQUIRES: Dict[Tuple[str, str], str] = {
     ("FeedColumnCache", "_tables_blob"): "store.colcache",
     ("CursorStore", "_repo"): "store.cursors",
     ("CursorStore", "_absorb"): "store.cursors",
+    ("OverloadController", "_tenant_row"): "serve.overload",
 }
 
 

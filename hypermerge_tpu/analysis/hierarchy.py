@@ -218,6 +218,14 @@ LOCK_CLASSES: Tuple[LockClass, ...] = (
         "flush (util.debounce) is always marked OUTSIDE it.",
     ),
     LockClass(
+        "serve.overload", 77,
+        "serve.overload.OverloadController._lock — the brownout "
+        "ladder's shared state: tenant token-bucket table, last "
+        "signal sample, ticker lifecycle. Held for dict/arith "
+        "bookkeeping only (telemetry shard installs nest inside); "
+        "the hot-path state probe is a GIL-atomic read outside it.",
+    ),
+    LockClass(
         "util.debounce", 78,
         "Debouncer._lock/_cv — mark/flush handshake. flush_fn runs "
         "with NO debouncer lock held, so flushes may take any lock; "

@@ -335,6 +335,33 @@ class RepoBackend:
                 self.serve = ServeTier(self)
             except Exception as e:
                 log("repo:backend", f"no serve tier: {e}")
+        # service plane (serve/overload.py): the brownout ladder
+        # watching this backend's own signals — serve read p99,
+        # admission-queue occupancy, WAL fsync debt — and enforcing
+        # at the read front door (read_doc) and the WAL ack path.
+        # HM_SERVICE=0 removes the controller entirely.
+        self.overload = None
+        if os.environ.get("HM_SERVICE", "1") != "0":
+            from ..serve.overload import (
+                HistogramWindow,
+                OverloadController,
+            )
+
+            self._serve_p99 = (
+                HistogramWindow(self.serve._hist)
+                if self.serve is not None
+                else None
+            )
+            self.overload = OverloadController(
+                signals=self._service_signals
+            )
+            wal = self.durability.wal
+            if wal is not None:
+                # SHED backpressure: the group-commit leader stretches
+                # its gather window — acks pace down, nothing acked is
+                # ever dropped
+                wal.ack_pacer = self.overload.ack_extra_s
+            self.overload.start()
 
     @staticmethod
     def _fsync_dir(path: str) -> None:
@@ -2070,6 +2097,24 @@ class RepoBackend:
     # ------------------------------------------------------------------
     # queries
 
+    def _service_signals(self) -> Dict[str, float]:
+        """The overload controller's pressure feed, all from numbers
+        the repo already measures: serve read p99 over the tick
+        window, admission-queue occupancy, WAL fsync debt over its
+        rotation budget. Runs on the controller ticker (~20 Hz)."""
+        sig = {"p99_s": 0.0, "queue_frac": 0.0, "debt_frac": 0.0}
+        serve = self.serve
+        if serve is not None:
+            if self._serve_p99 is not None:
+                sig["p99_s"] = self._serve_p99.quantile(0.99)
+            b = serve._batcher
+            if b._cap > 0:
+                sig["queue_frac"] = b.depth / b._cap
+        wal = self.durability.wal
+        if wal is not None:
+            sig["debt_frac"] = wal.fsync_debt() / max(1, wal._max_bytes)
+        return sig
+
     def read_doc(
         self, doc_id: str, query: Dict[str, Any], cb: Callable[[Any], None]
     ) -> None:
@@ -2077,7 +2122,16 @@ class RepoBackend:
         per-request host twin (HM_SERVE=0). `cb(payload)` may fire on
         the tier's batcher thread; payload None = unknown doc / not
         ready. A read NEVER creates state: a doc id with no stored
-        cursor answers None instead of materializing a phantom doc."""
+        cursor answers None instead of materializing a phantom doc.
+        The service plane's front door is HERE — every read, IPC or
+        in-process, passes the same admission check; a refused read
+        answers the typed {"overload": ...} payload, never an error
+        and never silence."""
+        if self.overload is not None:
+            refusal = self.overload.admit_read(query.get("tenant"))
+            if refusal is not None:
+                cb(refusal)
+                return
         doc = self.docs.get(doc_id)
         if doc is None:
             if not self.cursors.get(self.id, doc_id):
@@ -2106,6 +2160,11 @@ class RepoBackend:
         payload = telemetry.query_payload()
         if self.serve is not None:
             payload["serve"] = self.serve.residency_report()
+        if self.overload is not None:
+            # the service plane's attributable state: ladder rung,
+            # pressure, per-tenant quota table (tools/top.py
+            # [service], tools/ls.py service=, bench gating)
+            payload["service"] = self.overload.report()
         if self.network is not None:
             # DHT introspection (DhtSwarm.discovery_report: node id,
             # bucket occupancy, records, joined posture) for
@@ -2391,6 +2450,8 @@ class RepoBackend:
                 ctx.join()
             except Exception as e:
                 log("repo:backend", f"bulk fetch at close: {e}")
+        if self.overload is not None:
+            self.overload.close()  # stop the ticker before the tier
         if self.serve is not None:
             self.serve.close()  # drains: in-flight reads answer first
         if self.live is not None:

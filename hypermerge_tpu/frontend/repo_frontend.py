@@ -186,13 +186,25 @@ class RepoFrontend:
         convenience. Returns the read VALUE; None for an unknown /
         not-ready doc or a broken path — identical under HM_SERVE=1
         (batched device kernels over HBM-resident state) and
-        HM_SERVE=0 (per-request host materialization)."""
+        HM_SERVE=0 (per-request host materialization).
+
+        Under overload (serve/overload.py SHED state) the backend may
+        answer a typed refusal instead of a value: the blocking path
+        raises ``Overload`` (retry_after_s/state/tenant attached); the
+        cb path delivers ``{"_overload": {...}}`` — distinguishable
+        from every real read value, which is never a dict with that
+        key — so an async caller can back off instead of reading the
+        refusal as "doc unknown"."""
         doc_id = validate_doc_url(url)
         if cb is not None:
-            self._query(
-                msgs.read_query(doc_id, query),
-                lambda p: cb(None if p is None else p.get("value")),
-            )
+
+            def on_reply(p):
+                if isinstance(p, dict) and "overload" in p:
+                    cb({"_overload": p["overload"]})
+                    return
+                cb(None if p is None else p.get("value"))
+
+            self._query(msgs.read_query(doc_id, query), on_reply)
             return None
         done = threading.Event()
         slot: list = [None]
@@ -205,6 +217,10 @@ class RepoFrontend:
         if not done.wait(timeout):
             raise TimeoutError(f"read of {doc_id[:6]} timed out")
         payload = slot[0]
+        if isinstance(payload, dict) and "overload" in payload:
+            from ..serve.overload import overload_error
+
+            raise overload_error(payload["overload"])
         return None if payload is None else payload.get("value")
 
     def meta(self, url: str, cb: Callable[[Any], None]) -> None:

@@ -81,12 +81,23 @@ class GossipSampler:
         self._lock = make_lock("net.gossip")
         # key -> (expiry monotonic, chosen peer-id tuple)
         self._samples: Dict[str, Tuple[float, Tuple[str, ...]]] = {}
+        # service-plane hook (set once by Network wiring before
+        # traffic): an OverloadController whose BROWNOUT+ states thin
+        # the relay fanout so foreground reads keep the cores
+        self.overload_ctl = None
 
     def sample(self, key: str, peers: Sequence) -> List:
         """At most `fanout` of `peers` for this key — the same subset
         until the reshuffle deadline, provided every chosen peer is
         still present."""
         fanout = self.fanout
+        ctl = self.overload_ctl
+        if ctl is not None and fanout > 1 and ctl.deprioritize():
+            # brownout: the epidemic yields to foreground traffic —
+            # half the fanout (never below 1: relay still converges,
+            # and the anti-entropy sweep bounds any straggler)
+            fanout = max(1, fanout // 2)
+            ctl.note_thinned_gossip()
         if fanout <= 0 or len(peers) <= fanout:
             if peers:
                 _M_SENT.add(len(peers))

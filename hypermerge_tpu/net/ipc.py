@@ -196,6 +196,22 @@ class _FrontendHub:
             if t == "Query":
                 msg = dict(msg)
                 msg["queryId"] = [key, msg["queryId"]]
+                # tenant attribution for the service plane: every
+                # connection is its own tenant unless the client
+                # named one — the overload controller's quotas and
+                # refusal counters key on this
+                inner = msg.get("query")
+                if (
+                    isinstance(inner, dict)
+                    and inner.get("type") == "Read"
+                    and isinstance(inner.get("query"), dict)
+                    and "tenant" not in inner["query"]
+                ):
+                    inner = dict(inner)
+                    inner["query"] = dict(
+                        inner["query"], tenant=f"conn{key}"
+                    )
+                    msg["query"] = inner
             elif self._writers and t in (
                 "Create", "Open", "NeedsActorId"
             ):

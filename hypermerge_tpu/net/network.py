@@ -74,6 +74,14 @@ class Network:
         self.replication.on_sweep = getattr(
             backend, "send_sweep_cursors", None
         )
+        # service plane (serve/overload.py): under BROWNOUT+ the
+        # anti-entropy sweep skips its period and the gossip relay
+        # thins its fanout — background repair yields to foreground
+        # reads, bounded by the next healthy sweep
+        ctl = getattr(backend, "overload", None)
+        if ctl is not None:
+            self.replication.overload_ctl = ctl
+            self.gossip.overload_ctl = ctl
 
     # ------------------------------------------------------------------
     # swarm lifecycle

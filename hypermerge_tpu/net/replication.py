@@ -173,6 +173,10 @@ class ReplicationManager:
         # once per peer per sweep (Network wires it to
         # RepoBackend.send_sweep_cursors). Set before traffic flows.
         self.on_sweep: Optional[Callable] = None
+        # service-plane hook (same wiring window): an
+        # OverloadController whose BROWNOUT+ states skip the periodic
+        # sweep — repair is deferrable, foreground reads are not
+        self.overload_ctl = None
 
     @property
     def stats(self) -> Dict[str, float]:
@@ -806,6 +810,13 @@ class ReplicationManager:
 
     def _ae_loop(self) -> None:
         while not self._ae_stop.wait(self._ae_interval):
+            ctl = self.overload_ctl
+            if ctl is not None and ctl.deprioritize():
+                # brownout: the sweep yields this period (the NEXT
+                # healthy period repairs everything it would have —
+                # idempotent latest-state, just one period later)
+                ctl.note_skipped_sweep()
+                continue
             try:
                 self.sweep_now()
             except Exception as e:  # a bad peer must not kill the sweep

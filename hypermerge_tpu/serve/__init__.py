@@ -8,8 +8,23 @@ Layout:
 - ``batcher.py`` — bounded admission + debounced batch flush.
 - ``tier.py`` — ServeTier (the RepoBackend-facing surface) and
   ``host_read``, the bit-identical HM_SERVE=0 twin.
+- ``overload.py`` — the service plane: brownout ladder, per-tenant
+  quotas, typed Overload refusals (jax-free; frontends import it).
+
+The tier symbols resolve lazily (PEP 562): importing
+``serve.overload`` from a frontend process must not drag the kernel
+stack (tier -> resident -> kernels -> jax) into a process that never
+serves reads.
 """
 
-from .tier import READ_KINDS, ServeTier, host_read
+from typing import Any
 
 __all__ = ["READ_KINDS", "ServeTier", "host_read"]
+
+
+def __getattr__(name: str) -> Any:
+    if name in __all__:
+        from . import tier
+
+        return getattr(tier, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

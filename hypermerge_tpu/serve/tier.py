@@ -179,6 +179,7 @@ class ServeTier:
                 "reads", "hits", "installs", "invalidations",
                 "fallbacks", "evictions", "evictions_pressure",
                 "batches", "memo_hits", "host_memo_hits", "dispatches",
+                "overload_shed",
             )
         }
         for k in ("resident_docs", "resident_bytes", "queue_depth"):
@@ -207,9 +208,25 @@ class ServeTier:
         if kind not in READ_KINDS:
             self._finish_raw(req, None)
             return
-        if self._closed or not self._batcher.submit(req):
+        if self._closed:
             self._m["fallbacks"].add(1)
             self._fallback(req, doc)
+            return
+        if not self._batcher.submit(req):
+            # admission overflow is traffic pressure, not a device
+            # degradation: its own signal (serve.overload_shed, never
+            # serve.fallbacks), routed through the service plane — a
+            # typed refusal in SHED, the host path below it
+            self._m["overload_shed"].add(1)
+            ctl = getattr(self._back, "overload", None)
+            refusal = (
+                ctl.refuse_overflow(query.get("tenant"))
+                if ctl is not None else None
+            )
+            if refusal is not None:
+                self._finish_raw(req, refusal)
+            else:
+                self._fallback(req, doc)
             return
         self._m["queue_depth"].set(self._batcher.depth)
 
@@ -307,7 +324,15 @@ class ServeTier:
         if ready:
             self._resolve(ready)
         ready = []
+        ctl = getattr(self._back, "overload", None)
         for doc, clock, rs in cold:
+            if ctl is not None and ctl.defer_install(len(rs)):
+                # brownout: cold installs shed first — the reads
+                # still answer (host memo path), the device install
+                # waits for the ladder to step down
+                for r in rs:
+                    self._fallback(r, doc)
+                continue
             entry = self._install(doc, clock)
             if entry is None:
                 self._m["fallbacks"].add(len(rs))

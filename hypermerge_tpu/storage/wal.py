@@ -75,6 +75,7 @@ _M_APPENDS = telemetry.counter("storage.wal.appends")
 _M_BYTES = telemetry.counter("storage.wal.bytes")
 _M_FSYNCS = telemetry.counter("storage.wal.fsyncs")
 _M_CKPTS = telemetry.counter("storage.wal.checkpoints")
+_M_PACED = telemetry.counter("storage.wal.paced_commits")
 _M_REPLAYED = telemetry.counter("storage.wal.replayed")
 
 
@@ -138,6 +139,12 @@ class WriteAheadLog:
         self._dirty_names: Set[str] = set()
         self._ckpt_pending: Dict[str, object] = {}
         self._closed = False
+        # service-plane backpressure hook (set once at wiring, before
+        # writers exist): zero-arg callable returning extra seconds to
+        # add to the group-commit gather window while the overload
+        # controller is in SHED — acks pace down, writes are never
+        # dropped once acked (serve/overload.py)
+        self.ack_pacer = None
 
     # ------------------------------------------------------------------
     # append + group commit
@@ -211,6 +218,13 @@ class WriteAheadLog:
             ).start()
         return end
 
+    def fsync_debt(self) -> int:
+        """Bytes appended but not yet covered by a journal fsync —
+        the service plane's WAL pressure signal (serve/overload.py
+        normalizes it against HM_WAL_MAX_BYTES)."""
+        with self._cv:
+            return max(0, self._end - self._synced)
+
     def commit(self, end: int) -> None:
         """Block until the journal is durable through `end` — the
         group-commit handshake: the first committer in becomes the
@@ -236,8 +250,13 @@ class WriteAheadLog:
                     self._cv.wait(1.0)
             if not leader:
                 continue
-            if self._window_s > 0:
-                time.sleep(self._window_s)  # gather followers
+            pacer = self.ack_pacer
+            extra = float(pacer()) if pacer is not None else 0.0
+            if extra > 0:
+                _M_PACED.add(1)
+            gather = self._window_s + extra
+            if gather > 0:
+                time.sleep(gather)  # gather followers (+ backpressure)
             with self._cv:
                 fh = self._fh
                 target = self._end

@@ -79,6 +79,27 @@ PINNED_KEYS = (
     "t_pack_busy_per_worker",
     "coldopen_pack_speedup",
     "coldopen_pack_bound",
+    # service plane under overload (ISSUE 20): the nested block plus
+    # its headline aliases and the gate/attribution keys inside it
+    "config_service",
+    "config_service_qps",
+    "config_service_p50_ms",
+    "config_service_p99_ms",
+    "config_service_recovery_s",
+    "config_service_gated_ok",
+    "saturation_qps",
+    "recovery_to_slo_s",
+    "acked_lost",
+    "reads_shed",
+    "shed_reads",
+    "brownout_reads",
+    "deferred_installs",
+    "tenants",
+    "paced_commits",
+    "gates",
+    "gated_ok",
+    "write_p50_ms",
+    "write_p99_ms",
 )
 
 

@@ -707,3 +707,68 @@ def test_top_groups_wal_counters(tmp_path):
     assert "(+50.0/s)" in table
     # the non-journal storage counter stays in [storage]
     assert "[storage]" in table
+
+
+def test_top_service_group(tmp_path):
+    """The [service] group renders the overload controller's report
+    block: ladder state line, counter rates, per-tenant quota table —
+    and claims the service.* counters away from auto-grouping."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "hm_top", os.path.join(REPO_ROOT, "tools", "top.py")
+    )
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    cur = {
+        "counters": {
+            "service.shed_reads": 120,
+            "service.brownout_reads": 30,
+            "service.transitions": 3,
+            "storage.fsyncs": 9,
+        },
+        "service": {
+            "state": 2,
+            "state_name": "shed",
+            "pressure": 1.42,
+            "ack_stretch_ms": 25.0,
+            "transitions": 3,
+            "shed_reads": 120,
+            "brownout_reads": 30,
+            "deferred_installs": 7,
+            "tenants": {
+                "conn3": {
+                    "admitted": 50,
+                    "refused": 120,
+                    "quota_occupancy": 0.97,
+                },
+            },
+        },
+    }
+    prev = {"counters": {"service.shed_reads": 20}}
+    table = top.format_rows(prev, cur, 1.0)
+    assert "[service]" in table
+    assert "state shed" in table
+    assert "pressure 1.42" in table
+    assert "ack_stretch 25.0ms" in table
+    assert "service.shed_reads" in table
+    assert "(+100.0/s)" in table
+    assert "tenant conn3" in table
+    assert "quota 0.97" in table
+    # exactly ONE [service] header: the counters don't ALSO
+    # auto-group
+    assert table.count("[service]") == 1
+
+
+def test_ls_service_status_line(tmp_path):
+    """tools/ls.py prints the service: header off the Telemetry
+    payload when the backend runs the overload controller (the
+    HM_SERVICE=1 default)."""
+    path = str(tmp_path / "repo")
+    repo = Repo(path=path)
+    repo.create({"n": 1})
+    repo.close()
+    out = _run(["tools/ls.py", path])
+    assert out.returncode == 0, out.stderr
+    assert "service: healthy pressure=" in out.stdout
+    assert "tenants=0" in out.stdout

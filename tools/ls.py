@@ -35,6 +35,13 @@ A sharded daemon's docs live in per-worker shard repos
 (`<repo>/shard-<k>`); ls walks those too, one `shard-k  N docs`
 section each.
 
+The `service:` header line (shown when the backend runs the overload
+controller, HM_SERVICE=1, serve/overload.py) is the service plane at
+a glance: brownout-ladder rung (healthy/brownout/shed), live pressure,
+refusal and host-degradation totals, and how many quota tenants the
+front door has seen — the same `service` block tools/top.py renders
+as the [service] group.
+
 The `scrub=` column surfaces crash damage without a full scrub
 (storage/scrub.py doc_status): `ok`, `recovered` (the last crash
 recovery repaired something for this doc's feeds — torn tails,
@@ -162,6 +169,19 @@ def main() -> None:
     serve = payload.get("serve")
     net = (payload.get("net") or {}).get("docs", {})
     dht = payload.get("dht")
+    svc = payload.get("service")
+    if svc is not None:
+        # service plane (serve/overload.py): one status line — ladder
+        # rung, live pressure, refusal/degradation totals, tenant
+        # count — from the same Telemetry payload tools/top.py polls
+        print(
+            f"service: {svc.get('state_name', '?')} "
+            f"pressure={float(svc.get('pressure', 0.0)):.2f} "
+            f"shed={svc.get('shed_reads', 0)} "
+            f"brownout={svc.get('brownout_reads', 0)} "
+            f"deferred={svc.get('deferred_installs', 0)} "
+            f"tenants={len(svc.get('tenants') or {})}"
+        )
     if dht is not None:
         # DHT-discovered daemon: one header line of swarm truth (the
         # per-doc peers=/announce= columns below come from the same

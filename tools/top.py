@@ -40,6 +40,15 @@ to them: ``net.cursor.full_tx`` vs ``net.cursor.delta_tx`` vs
 plus ``dht.sign_cache_hits`` and ``dht.seeds_tx``/``dht.seeds_rx``
 (announce-signing amortization and push-seeding) in ``[dht]``.
 
+ISSUE 20's service plane (``HM_SERVICE``, serve/overload.py) renders
+the ``[service]`` group from the payload's ``service`` report block:
+the brownout ladder's live rung + pressure + ack-pacing stretch on
+one line, the controller's counter rates (``service.shed_reads``/s is
+the refusal rate, ``service.brownout_reads``/s the host-memo
+degradation rate, ``service.transitions`` the ladder's movement), and
+one row per quota tenant — admitted/refused totals plus current
+token-bucket occupancy (1.0 = exhausted).
+
 Instrumented daemons (HM_LOCKDEP=1 / HM_RACEDEP=1) additionally show
 the ``[lock]`` group: ``lock.held_blocking_ms.<class>`` rates — the
 per-lock-class blocking-debt series whose ``live_engine`` row is the
@@ -134,6 +143,7 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
     counters = cur.get("counters", {})
     prev_counters = (prev or {}).get("counters", {})
     workers = cur.get("workers") or {}
+    svc = cur.get("service") or {}
     by_sub = {}
     for name, v in counters.items():
         sub = name.split(".", 1)[0]
@@ -144,6 +154,8 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
             sub = "wal"
         if workers and name.startswith("workers."):
             continue  # rendered as the [workers] fleet table below
+        if svc and name.startswith("service."):
+            continue  # rendered as the [service] group below
         by_sub.setdefault(sub, []).append((name, v))
     lines = []
     for sub in sorted(by_sub):
@@ -183,6 +195,40 @@ def format_rows(prev: dict, cur: dict, dt: float) -> str:
                 f"edits {w.get('edits', 0):>10,}{rate}  "
                 f"queue {w.get('queue', 0):,}  "
                 f"respawns {w.get('respawns', 0):,}"
+            )
+    if svc:
+        # the overload controller (serve/overload.py): ladder rung +
+        # live pressure + write ack-pacing on one line, refusal/
+        # degradation rates below, then the per-tenant quota table
+        lines.append("[service]")
+        lines.append(
+            f"  state {svc.get('state_name', '?'):<9} "
+            f"pressure {float(svc.get('pressure', 0.0)):.2f}  "
+            f"ack_stretch {svc.get('ack_stretch_ms', 0)}ms  "
+            f"transitions {svc.get('transitions', 0):,}"
+        )
+        skip = ("service.state", "service.pressure",
+                "service.ack_stretch_ms")
+        for name in sorted(
+            n for n in counters
+            if n.startswith("service.") and n not in skip
+        ):
+            v = counters[name]
+            delta = v - prev_counters.get(name, 0)
+            if not v and not delta:
+                continue
+            rate = ""
+            if prev and dt > 0 and delta:
+                rate = f"  ({delta / dt:+,.1f}/s)"
+            if isinstance(v, float):
+                v = round(v, 3)
+            lines.append(f"  {name:<32} {v:>14,}{rate}")
+        for t, row in sorted((svc.get("tenants") or {}).items()):
+            lines.append(
+                f"  tenant {t:<14} "
+                f"admitted {row.get('admitted', 0):>10,}  "
+                f"refused {row.get('refused', 0):>10,}  "
+                f"quota {float(row.get('quota_occupancy', 0.0)):.2f}"
             )
     if cur.get("tracing"):
         lines.append(
